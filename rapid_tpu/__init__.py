@@ -1,0 +1,36 @@
+"""rapid-tpu: a TPU-native framework with the capabilities of Rapid.
+
+Rapid (USENIX ATC'18, reference Java implementation at /root/reference) is a
+distributed membership service: processes monitor each other over a K-ring
+expander overlay, detect multi-node cuts via H/L watermarks, and agree on every
+membership change through leaderless Fast Paxos with a classic-Paxos fallback.
+
+This framework provides those capabilities TPU-first: instead of N JVM
+processes exchanging RPCs, all N simulated cluster nodes advance at once as
+batched message-passing kernels on TPU (JAX/XLA/pallas/pjit).  Two
+implementations of one protocol spec live side by side:
+
+- ``rapid_tpu.oracle``  — an exact-semantics, tick-driven host implementation
+  of the full protocol (ground truth for differential testing, and the
+  small-N product: real multi-node clusters in one process, mirroring the
+  reference's in-process-transport ClusterTest setup).
+- ``rapid_tpu.engine``  — the batched kernel engine: capacity-padded per-node
+  state tensors, one jitted tick step for the whole cluster, fault injection
+  as edge-mask tensors, sharded over a device mesh via jax.sharding.
+
+See SURVEY.md for the reference layer map this mirrors.
+"""
+
+__version__ = "0.1.0"
+
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import EdgeStatus, Endpoint, JoinStatusCode, NodeId
+
+__all__ = [
+    "Settings",
+    "Endpoint",
+    "NodeId",
+    "EdgeStatus",
+    "JoinStatusCode",
+    "__version__",
+]

@@ -1,0 +1,175 @@
+"""Seeded 64-bit hashing shared by the host oracle and the TPU kernels.
+
+The reference orders each ring by a seeded XXHash of the endpoint
+(MembershipView.java:47,562-587) and derives configuration identifiers from a
+37x polynomial over XXHashes (MembershipView.java:540-556). Protocol semantics
+only require a *fixed pseudorandom total order* and a collision-resistant
+configuration fingerprint — not XXHash specifically — so (per SURVEY.md §7
+"hash parity") both sides of this framework share one hash: splitmix64-style
+finalizers.
+
+TPUs have no native 64-bit integers without enabling jax x64 globally (which
+would double the cost of every int op in the hot kernels), so the canonical
+implementation here operates on (hi, lo) uint32 limb pairs and is written
+against an array-namespace parameter ``xp`` that may be ``numpy`` or
+``jax.numpy``. The oracle and the engine call the *same* function, so ring
+order and config ids agree by construction.
+
+All Python-int helpers treat values as unsigned 64-bit.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+MASK64 = (1 << 64) - 1
+MASK32 = (1 << 32) - 1
+
+# splitmix64 constants
+_GAMMA = 0x9E3779B97F4A7C15
+_MIX1 = 0xBF58476D1CE4E5B9
+_MIX2 = 0x94D049BB133111EB
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python reference (host-side scalars: endpoint/uuid fingerprints)
+# ---------------------------------------------------------------------------
+
+
+def splitmix64(x: int) -> int:
+    """The splitmix64 finalizer on a python int (unsigned 64-bit)."""
+    z = (x + _GAMMA) & MASK64
+    z = ((z ^ (z >> 30)) * _MIX1) & MASK64
+    z = ((z ^ (z >> 27)) * _MIX2) & MASK64
+    return z ^ (z >> 31)
+
+
+def hash64(x: int, seed: int = 0) -> int:
+    """Seeded 64-bit hash of a 64-bit value."""
+    return splitmix64((x ^ splitmix64(seed & MASK64)) & MASK64)
+
+
+def fingerprint_bytes(data: bytes, seed: int = 0) -> int:
+    """64-bit fingerprint of a byte string (FNV-1a 64 core + splitmix finalize).
+
+    Host-side only: used to turn endpoint hostnames into uint64 identities.
+    """
+    h = 0xCBF29CE484222325 ^ hash64(seed)
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & MASK64
+    return splitmix64(h)
+
+
+# ---------------------------------------------------------------------------
+# Limb-based (hi, lo) uint32 implementation, numpy/jax.numpy polymorphic
+# ---------------------------------------------------------------------------
+
+
+def _u32(xp, v: int):
+    return xp.uint32(v & MASK32)
+
+
+def mul32_wide(xp, a, b):
+    """32x32 -> 64 multiply on uint32 arrays, returning (hi, lo) uint32."""
+    a = a.astype(xp.uint32)
+    b = b.astype(xp.uint32)
+    a0 = a & xp.uint32(0xFFFF)
+    a1 = a >> xp.uint32(16)
+    b0 = b & xp.uint32(0xFFFF)
+    b1 = b >> xp.uint32(16)
+    # partial products, each fits in 32 bits
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    # mid = p01 + p10 + (p00 >> 16): may carry into bit 33
+    mid = p01 + (p00 >> xp.uint32(16))
+    carry1 = (mid < p01).astype(xp.uint32)  # wrapped?
+    mid2 = mid + p10
+    carry2 = (mid2 < p10).astype(xp.uint32)
+    lo = (p00 & xp.uint32(0xFFFF)) | (mid2 << xp.uint32(16))
+    hi = p11 + (mid2 >> xp.uint32(16)) + ((carry1 + carry2) << xp.uint32(16))
+    return hi, lo
+
+
+def add64(xp, ahi, alo, bhi, blo):
+    lo = alo + blo
+    carry = (lo < alo).astype(xp.uint32)
+    hi = ahi + bhi + carry
+    return hi, lo
+
+
+def xor64(ahi, alo, bhi, blo):
+    return ahi ^ bhi, alo ^ blo
+
+
+def shr64(xp, hi, lo, n: int):
+    """Logical right shift by constant 0 < n < 64."""
+    assert 0 < n < 64
+    if n < 32:
+        new_lo = (lo >> xp.uint32(n)) | (hi << xp.uint32(32 - n))
+        new_hi = hi >> xp.uint32(n)
+    else:
+        new_lo = hi >> xp.uint32(n - 32) if n > 32 else hi
+        new_hi = xp.zeros_like(hi)
+    return new_hi, new_lo
+
+
+def mul64(xp, ahi, alo, bhi, blo):
+    """Low 64 bits of a 64x64 multiply, on (hi, lo) uint32 limbs."""
+    hi_ll, lo_ll = mul32_wide(xp, alo, blo)
+    hi = hi_ll + alo * bhi + ahi * blo  # mod 2^32 per term
+    return hi, lo_ll
+
+
+def _mul64_const(xp, hi, lo, c: int):
+    chi = _u32(xp, c >> 32)
+    clo = _u32(xp, c)
+    return mul64(xp, hi, lo, chi, clo)
+
+
+def splitmix64_limbs(xp, hi, lo):
+    """splitmix64 finalizer on (hi, lo) uint32 arrays; matches splitmix64()."""
+    hi = hi.astype(xp.uint32)
+    lo = lo.astype(xp.uint32)
+    hi, lo = add64(xp, hi, lo, _u32(xp, _GAMMA >> 32), _u32(xp, _GAMMA))
+    shi, slo = shr64(xp, hi, lo, 30)
+    hi, lo = xor64(hi, lo, shi, slo)
+    hi, lo = _mul64_const(xp, hi, lo, _MIX1)
+    shi, slo = shr64(xp, hi, lo, 27)
+    hi, lo = xor64(hi, lo, shi, slo)
+    hi, lo = _mul64_const(xp, hi, lo, _MIX2)
+    shi, slo = shr64(xp, hi, lo, 31)
+    return xor64(hi, lo, shi, slo)
+
+
+def hash64_limbs(xp, hi, lo, seed: int = 0):
+    """Seeded hash on (hi, lo) uint32 arrays; matches hash64()."""
+    s = splitmix64(seed & MASK64)
+    hi2 = hi.astype(xp.uint32) ^ _u32(xp, s >> 32)
+    lo2 = lo.astype(xp.uint32) ^ _u32(xp, s)
+    return splitmix64_limbs(xp, hi2, lo2)
+
+
+# ---------------------------------------------------------------------------
+# Conversions
+# ---------------------------------------------------------------------------
+
+
+def to_limbs(x: int) -> Tuple[int, int]:
+    x &= MASK64
+    return (x >> 32) & MASK32, x & MASK32
+
+
+def from_limbs(hi: int, lo: int) -> int:
+    return ((int(hi) & MASK32) << 32) | (int(lo) & MASK32)
+
+
+def np_to_limbs(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    arr = arr.astype(np.uint64)
+    return (arr >> np.uint64(32)).astype(np.uint32), (arr & np.uint64(MASK32)).astype(np.uint32)
+
+
+def np_from_limbs(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)

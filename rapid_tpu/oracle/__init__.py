@@ -1,0 +1,36 @@
+"""Exact-semantics host oracle of the Rapid protocol.
+
+This package is a tick-driven, deterministic reimplementation of the
+reference's protocol core (SURVEY.md §2.2): MembershipView, the multi-node
+cut detector, FastPaxos + classic Paxos, and the MembershipService state
+machine, plus a deterministic in-process messaging substrate. It serves as
+
+1. ground truth for differential testing of the batched TPU kernel engine
+   (``rapid_tpu.engine``), and
+2. the small-N product: real multi-node clusters simulated in one process,
+   the same leverage the reference gets from its in-process-transport
+   ClusterTest (SURVEY.md §4.4).
+"""
+
+from rapid_tpu.oracle.membership_view import (
+    MembershipView,
+    Configuration,
+    NodeAlreadyInRingError,
+    NodeNotInRingError,
+    UUIDAlreadySeenError,
+)
+from rapid_tpu.oracle.cut_detector import MultiNodeCutDetector
+from rapid_tpu.oracle.paxos import Paxos, FastPaxos
+from rapid_tpu.oracle.metadata import MetadataManager
+
+__all__ = [
+    "MembershipView",
+    "Configuration",
+    "MultiNodeCutDetector",
+    "Paxos",
+    "FastPaxos",
+    "MetadataManager",
+    "NodeAlreadyInRingError",
+    "NodeNotInRingError",
+    "UUIDAlreadySeenError",
+]

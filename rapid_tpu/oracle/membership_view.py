@@ -1,0 +1,263 @@
+"""K-ring expander membership view (host oracle).
+
+Mirrors the semantics of the reference MembershipView
+(rapid/src/main/java/com/vrg/rapid/MembershipView.java):
+
+- K logical rings, each ordering all members by a seeded 64-bit hash of the
+  endpoint (reference: seeded XXHash, MembershipView.java:47,562-587; here the
+  shared splitmix64 of rapid_tpu.hashing, with (hash, endpoint-id) as the sort
+  key so the order is total even under hash collisions).
+- Observers of a member = its successor on each ring
+  (MembershipView.java:234-257); subjects = predecessor on each ring
+  (:267-282,308-322).
+- Expected observers of a *joiner* (not yet in the rings) = the predecessors
+  of its would-be position (:292-303) — note the reference deliberately uses
+  predecessors here, not successors; these gatekeepers send the UP alerts.
+- Join safety: reject reused hostnames and reused node identifiers
+  (:100-115); identifiers are remembered forever (:51).
+- Configuration identity: a 64-bit fingerprint of (identifiers seen, current
+  members). The reference uses an order-dependent 37x polynomial
+  (:540-556); since both operand sequences are themselves functions of the
+  *sets* (ids sorted, endpoints in ring-0 order), an order-independent sum of
+  per-element fingerprints finalized with splitmix64 carries the same
+  information and is one reduction on TPU. The oracle and the kernel engine
+  share this formula (rapid_tpu.hashing / engine state).
+
+The rings are represented once: a single sorted list per ring of
+(ring_key, endpoint_id, Endpoint). N here is oracle-scale (<= a few thousand);
+insertion is O(N) via bisect which is plenty.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from rapid_tpu import hashing
+from rapid_tpu.types import Endpoint, JoinStatusCode, NodeId
+
+MASK64 = hashing.MASK64
+
+# Seeds for the various hash domains (arbitrary but fixed).
+_SEED_ID_HIGH = 0x6964_6869
+_SEED_ID_LOW = 0x6964_6C6F
+_SEED_MEMBER = 0x6D656D62
+
+
+def endpoint_uid(endpoint: Endpoint) -> int:
+    """64-bit identity of an endpoint (host-side; cached on first use)."""
+    return hashing.fingerprint_bytes(
+        endpoint.hostname.encode(), seed=0x686F7374
+    ) ^ hashing.hash64(endpoint.port, seed=0x706F7274)
+
+
+_uid_cache: Dict[Endpoint, int] = {}
+
+
+def uid_of(endpoint: Endpoint) -> int:
+    uid = _uid_cache.get(endpoint)
+    if uid is None:
+        uid = endpoint_uid(endpoint)
+        _uid_cache[endpoint] = uid
+    return uid
+
+
+def ring_key(endpoint: Endpoint, k: int) -> int:
+    """Sort key of ``endpoint`` on ring ``k``."""
+    return hashing.hash64(uid_of(endpoint), seed=k)
+
+
+def id_fingerprint(node_id: NodeId) -> int:
+    """Per-identifier contribution to the configuration id."""
+    return hashing.splitmix64(
+        (hashing.hash64(node_id.high & MASK64, _SEED_ID_HIGH)
+         + hashing.hash64(node_id.low & MASK64, _SEED_ID_LOW)) & MASK64
+    )
+
+
+def member_fingerprint(endpoint: Endpoint) -> int:
+    """Per-member contribution to the configuration id."""
+    return hashing.hash64(uid_of(endpoint), seed=_SEED_MEMBER)
+
+
+def configuration_id(id_fp_sum: int, member_fp_sum: int) -> int:
+    """Combine the two running sums into the 64-bit configuration id."""
+    return hashing.splitmix64(
+        (hashing.splitmix64(id_fp_sum & MASK64) + (member_fp_sum & MASK64)) & MASK64
+    )
+
+
+class NodeAlreadyInRingError(RuntimeError):
+    pass
+
+
+class NodeNotInRingError(RuntimeError):
+    pass
+
+
+class UUIDAlreadySeenError(RuntimeError):
+    pass
+
+
+class Configuration:
+    """Snapshot sufficient to bootstrap an identical view.
+
+    Reference: MembershipView.Configuration (MembershipView.java:526-557);
+    what joiners receive (MembershipService.java:729-737) and this
+    framework's checkpoint format (SURVEY.md §5 checkpoint/resume).
+    """
+
+    def __init__(self, node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint]):
+        self.node_ids: Tuple[NodeId, ...] = tuple(node_ids)
+        self.endpoints: Tuple[Endpoint, ...] = tuple(endpoints)
+
+    def get_configuration_id(self) -> int:
+        id_sum = sum(id_fingerprint(i) for i in self.node_ids) & MASK64
+        mem_sum = sum(member_fingerprint(e) for e in self.endpoints) & MASK64
+        return configuration_id(id_sum, mem_sum)
+
+
+class MembershipView:
+    """K rings of the membership, ordered by seeded hash."""
+
+    def __init__(self, k: int, node_ids: Sequence[NodeId] = (),
+                 endpoints: Sequence[Endpoint] = ()):
+        assert k > 0
+        self.K = k
+        # ring[k] is a sorted list of (ring_key, uid, Endpoint)
+        self._rings: List[List[Tuple[int, int, Endpoint]]] = [[] for _ in range(k)]
+        self._all_nodes: Dict[Endpoint, None] = {}
+        self._identifiers_seen: set[NodeId] = set()
+        self._id_fp_sum = 0
+        self._member_fp_sum = 0
+        self._cached_observers: Dict[Endpoint, List[Endpoint]] = {}
+        for node_id in node_ids:
+            self._identifiers_seen.add(node_id)
+            self._id_fp_sum = (self._id_fp_sum + id_fingerprint(node_id)) & MASK64
+        for endpoint in endpoints:
+            self._insert(endpoint)
+
+    # -- internal helpers ---------------------------------------------------
+
+    def _entry(self, endpoint: Endpoint, k: int) -> Tuple[int, int, Endpoint]:
+        return (ring_key(endpoint, k), uid_of(endpoint), endpoint)
+
+    def _insert(self, endpoint: Endpoint) -> None:
+        for k in range(self.K):
+            bisect.insort(self._rings[k], self._entry(endpoint, k))
+        self._all_nodes[endpoint] = None
+        self._member_fp_sum = (self._member_fp_sum + member_fingerprint(endpoint)) & MASK64
+
+    def _remove(self, endpoint: Endpoint) -> None:
+        for k in range(self.K):
+            ring = self._rings[k]
+            i = bisect.bisect_left(ring, self._entry(endpoint, k))
+            assert i < len(ring) and ring[i][2] == endpoint
+            ring.pop(i)
+        del self._all_nodes[endpoint]
+        self._member_fp_sum = (self._member_fp_sum - member_fingerprint(endpoint)) & MASK64
+
+    def _neighbor(self, k: int, endpoint: Endpoint, direction: int) -> Optional[Endpoint]:
+        """Successor (+1) or predecessor (-1) of ``endpoint``'s position on
+        ring ``k`` (endpoint itself excluded, wrap-around)."""
+        ring = self._rings[k]
+        if not ring:
+            return None
+        entry = self._entry(endpoint, k)
+        if direction > 0:
+            i = bisect.bisect_right(ring, entry)
+            candidate = ring[i % len(ring)]
+        else:
+            i = bisect.bisect_left(ring, entry)
+            candidate = ring[(i - 1) % len(ring)]
+        if candidate[2] == endpoint:
+            return None  # only element is the endpoint itself
+        return candidate[2]
+
+    # -- queries (reference API surface) ------------------------------------
+
+    def is_safe_to_join(self, node: Endpoint, node_id: NodeId) -> JoinStatusCode:
+        """MembershipView.java:100-115."""
+        if node in self._all_nodes:
+            return JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+        if node_id in self._identifiers_seen:
+            return JoinStatusCode.UUID_ALREADY_IN_RING
+        return JoinStatusCode.SAFE_TO_JOIN
+
+    def ring_add(self, node: Endpoint, node_id: NodeId) -> None:
+        """MembershipView.java:123-160."""
+        if node_id in self._identifiers_seen:
+            raise UUIDAlreadySeenError(f"{node} identifier already seen: {node_id}")
+        if node in self._all_nodes:
+            raise NodeAlreadyInRingError(str(node))
+        self._insert(node)
+        self._identifiers_seen.add(node_id)
+        self._id_fp_sum = (self._id_fp_sum + id_fingerprint(node_id)) & MASK64
+        self._cached_observers.clear()
+
+    def ring_delete(self, node: Endpoint) -> None:
+        """MembershipView.java:167-201."""
+        if node not in self._all_nodes:
+            raise NodeNotInRingError(str(node))
+        self._remove(node)
+        self._cached_observers.clear()
+
+    def get_observers_of(self, node: Endpoint) -> List[Endpoint]:
+        """Ring successors of a member (MembershipView.java:210-257)."""
+        if node not in self._all_nodes:
+            raise NodeNotInRingError(str(node))
+        cached = self._cached_observers.get(node)
+        if cached is not None:
+            return list(cached)
+        if len(self._all_nodes) <= 1:
+            result: List[Endpoint] = []
+        else:
+            result = [self._neighbor(k, node, +1) for k in range(self.K)]
+        self._cached_observers[node] = result
+        return list(result)
+
+    def get_subjects_of(self, node: Endpoint) -> List[Endpoint]:
+        """Ring predecessors of a member (MembershipView.java:267-282)."""
+        if node not in self._all_nodes:
+            raise NodeNotInRingError(str(node))
+        if len(self._all_nodes) <= 1:
+            return []
+        return [self._neighbor(k, node, -1) for k in range(self.K)]
+
+    def get_expected_observers_of(self, node: Endpoint) -> List[Endpoint]:
+        """Gatekeepers for a joiner: predecessors of its would-be position
+        (MembershipView.java:292-303 — deliberately predecessors)."""
+        if not self._rings[0]:
+            return []
+        return [self._neighbor(k, node, -1) for k in range(self.K)]
+
+    def get_ring_numbers(self, observer: Endpoint, subject: Endpoint) -> List[int]:
+        """Indices k such that ``subject`` is ``observer``'s subject on ring k
+        (MembershipView.java:397-418)."""
+        subjects = self.get_subjects_of(observer)
+        return [k for k, s in enumerate(subjects) if s == subject]
+
+    def is_host_present(self, endpoint: Endpoint) -> bool:
+        return endpoint in self._all_nodes
+
+    def is_identifier_present(self, node_id: NodeId) -> bool:
+        return node_id in self._identifiers_seen
+
+    def get_ring(self, k: int) -> List[Endpoint]:
+        return [e for _, _, e in self._rings[k]]
+
+    def get_membership_size(self) -> int:
+        return len(self._all_nodes)
+
+    def get_current_configuration_id(self) -> int:
+        return configuration_id(self._id_fp_sum, self._member_fp_sum)
+
+    def get_configuration(self) -> Configuration:
+        return Configuration(
+            sorted(self._identifiers_seen, key=lambda i: (i.high, i.low)),
+            self.get_ring(0),
+        )
+
+    def ring0_sort_key(self, endpoint: Endpoint):
+        """Consistent sort order for endpoint lists (ring-0 hash order);
+        reference AddressComparator on ring 0 (MembershipView.java:470-472)."""
+        return (ring_key(endpoint, 0), uid_of(endpoint))

@@ -1,0 +1,69 @@
+"""Configuration for the framework.
+
+The reference keeps a flat Settings POJO (Settings.java:23-31) with
+per-component ISettings interface views, but hardcodes K/H/L as compile-time
+constants (Cluster.java:72-74). Here K/H/L, capacity, tick mapping and fault
+model parameters are all first-class config, per SURVEY.md §5 ("make K/H/L,
+N, fault matrices, and RNG seeds first-class config").
+
+Time model: the simulator advances in discrete ticks. One tick corresponds to
+the reference's alert batching window (100 ms, MembershipService.java:75), so
+reference timers map to tick counts:
+
+- batching window 100 ms      -> 1 tick      (flush when quiescent >= 1 tick)
+- failure-detector interval 1 s -> ``fd_interval_ticks`` = 10
+- consensus fallback base 1 s -> ``fallback_base_delay_ticks`` = 10 plus an
+  expovariate jitter with rate 1/N ticks (FastPaxos.java:200-203)
+- message latency: a message sent in tick t is delivered in tick t+1.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class Settings:
+    # --- overlay / cut detection (Cluster.java:72-74 hardcodes 10/9/4) ---
+    K: int = 10
+    H: int = 9
+    L: int = 4
+
+    # --- time model (see module docstring) ---
+    tick_ms: int = 100
+    batching_window_ticks: int = 1
+    fd_interval_ticks: int = 10
+    fallback_base_delay_ticks: int = 10
+
+    # --- failure detector (PingPongFailureDetector.java:41-45) ---
+    fd_failure_threshold: int = 10
+    fd_bootstrap_tolerance: int = 30
+
+    # --- join protocol (Settings.java defaults: join timeout 5000ms, 5 tries)
+    join_attempts: int = 5
+    join_timeout_ticks: int = 50
+
+    # --- leave (MembershipService.java:78) ---
+    leave_timeout_ticks: int = 15
+
+    # --- engine capacity / scale knobs ---
+    capacity: int = 0           # 0 = derive from initial membership + joiners
+    max_configs: int = 4        # config ring-buffer depth on device
+    max_proposals: int = 4      # distinct consensus values tracked per config
+    max_cut_size: int = 64      # max nodes per view-change proposal
+    max_active_dsts: int = 128  # alert destinations tracked per config
+
+    # --- randomness ---
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if not (self.K >= 3 and self.K >= self.H >= self.L > 0):
+            raise ValueError(
+                f"Arguments do not satisfy K >= H >= L > 0, K >= 3: "
+                f"(K: {self.K}, H: {self.H}, L: {self.L})"
+            )
+
+    def with_(self, **kw) -> "Settings":
+        return replace(self, **kw)
+
+
+DEFAULT_SETTINGS = Settings()

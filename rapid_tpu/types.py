@@ -1,0 +1,224 @@
+"""Core wire/protocol types.
+
+The reference defines these in protobuf (rapid/src/main/proto/rapid.proto):
+``Endpoint`` (:13-17), ``NodeId`` (:50-54), ``EdgeStatus`` / alert messages
+(:95-115), the join protocol (:57-91) and consensus messages (:124-169).
+Here they are plain immutable Python dataclasses: the oracle passes them
+in-process, and the kernel engine lowers them to integer tensors (slot ids +
+64-bit hashes) — there is no RPC wire format to serialize for.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True, order=True)
+class Endpoint:
+    """A node address. Reference: rapid.proto:13-17 (hostname bytes + port)."""
+
+    hostname: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.hostname}:{self.port}"
+
+    @staticmethod
+    def parse(s: str) -> "Endpoint":
+        host, _, port = s.rpartition(":")
+        if not host or not port:
+            raise ValueError(f"malformed endpoint: {s!r}")
+        return Endpoint(host, int(port))
+
+
+@dataclass(frozen=True, order=True)
+class NodeId:
+    """A 128-bit logical node identifier. Reference: rapid.proto:50-54.
+
+    The reference orders NodeIds by (high, low) (MembershipView.java:474-500);
+    dataclass ordering on (high, low) reproduces that.
+    """
+
+    high: int
+    low: int
+
+
+class EdgeStatus(enum.Enum):
+    UP = 0
+    DOWN = 1
+
+
+class JoinStatusCode(enum.Enum):
+    """Reference: rapid.proto:85-91."""
+
+    HOSTNAME_ALREADY_IN_RING = 0
+    UUID_ALREADY_IN_RING = 1
+    SAFE_TO_JOIN = 2
+    CONFIG_CHANGED = 3
+    MEMBERSHIP_REJECTED = 4
+
+
+Metadata = Dict[str, bytes]
+
+
+# ---------------------------------------------------------------------------
+# Protocol messages (the RapidRequest oneof, rapid.proto:21-45)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PreJoinMessage:
+    """Join phase 1, joiner -> seed. Reference: rapid.proto:58-63."""
+
+    sender: Endpoint
+    node_id: NodeId
+
+
+@dataclass(frozen=True)
+class JoinMessage:
+    """Join phase 2, joiner -> observer. Reference: rapid.proto:65-73."""
+
+    sender: Endpoint
+    node_id: NodeId
+    configuration_id: int
+    ring_numbers: Tuple[int, ...]
+    metadata: Tuple[Tuple[str, bytes], ...] = ()
+
+
+@dataclass(frozen=True)
+class JoinResponse:
+    """Reference: rapid.proto:75-84."""
+
+    sender: Endpoint
+    status_code: JoinStatusCode
+    configuration_id: int
+    endpoints: Tuple[Endpoint, ...] = ()
+    identifiers: Tuple[NodeId, ...] = ()
+    metadata: Tuple[Tuple[Endpoint, Tuple[Tuple[str, bytes], ...]], ...] = ()
+
+
+@dataclass(frozen=True)
+class AlertMessage:
+    """An edge-status report. Reference: rapid.proto:99-110.
+
+    ``node_id``/``metadata`` ride along only on UP (join) alerts.
+    """
+
+    edge_src: Endpoint
+    edge_dst: Endpoint
+    edge_status: EdgeStatus
+    configuration_id: int
+    ring_numbers: Tuple[int, ...]
+    node_id: Optional[NodeId] = None
+    metadata: Tuple[Tuple[str, bytes], ...] = ()
+
+
+@dataclass(frozen=True)
+class BatchedAlertMessage:
+    """Reference: rapid.proto:112-115."""
+
+    sender: Endpoint
+    messages: Tuple[AlertMessage, ...]
+
+
+@dataclass(frozen=True)
+class FastRoundPhase2bMessage:
+    """A fast-round vote. Reference: rapid.proto:124-129."""
+
+    sender: Endpoint
+    configuration_id: int
+    endpoints: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True, order=True)
+class Rank:
+    """Classic-round rank (round, node_index). Reference: rapid.proto:133-136.
+
+    Ordering is lexicographic (round, node_index), matching
+    Paxos.java:333-339.
+    """
+
+    round: int
+    node_index: int
+
+
+@dataclass(frozen=True)
+class Phase1aMessage:
+    sender: Endpoint
+    configuration_id: int
+    rank: Rank
+
+
+@dataclass(frozen=True)
+class Phase1bMessage:
+    sender: Endpoint
+    configuration_id: int
+    rnd: Rank
+    vrnd: Rank
+    vval: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True)
+class Phase2aMessage:
+    sender: Endpoint
+    configuration_id: int
+    rnd: Rank
+    vval: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True)
+class Phase2bMessage:
+    sender: Endpoint
+    configuration_id: int
+    rnd: Rank
+    endpoints: Tuple[Endpoint, ...]
+
+
+@dataclass(frozen=True)
+class LeaveMessage:
+    """Reference: rapid.proto:185-188."""
+
+    sender: Endpoint
+
+
+@dataclass(frozen=True)
+class ProbeMessage:
+    sender: Endpoint
+
+
+class ProbeStatus(enum.Enum):
+    OK = 0
+    BOOTSTRAPPING = 1
+
+
+@dataclass(frozen=True)
+class ProbeResponse:
+    status: ProbeStatus = ProbeStatus.OK
+
+
+@dataclass(frozen=True)
+class Response:
+    """Generic empty response (RapidResponse with no payload)."""
+
+
+RapidRequest = (
+    PreJoinMessage
+    | JoinMessage
+    | BatchedAlertMessage
+    | FastRoundPhase2bMessage
+    | Phase1aMessage
+    | Phase1bMessage
+    | Phase2aMessage
+    | Phase2bMessage
+    | LeaveMessage
+    | ProbeMessage
+)
+
+CONSENSUS_MESSAGE_TYPES = (
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+)

@@ -1,0 +1,156 @@
+"""Oracle cut-detector tests, mirroring the reference CutDetectionTest.java
+scenario matrix (K=10, H=8, L=2 — the tests sweep K/H/L rather than the
+production 10/9/4)."""
+import pytest
+
+from rapid_tpu.oracle import MembershipView, MultiNodeCutDetector
+from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint, NodeId
+
+K, H, L = 10, 8, 2
+CONFIG = -1  # does not affect these tests
+
+_id = 0
+
+
+def fresh_id() -> NodeId:
+    global _id
+    _id += 1
+    return NodeId(_id, _id * 31)
+
+
+def alert(src: Endpoint, dst: Endpoint, status: EdgeStatus, ring: int) -> AlertMessage:
+    return AlertMessage(src, dst, status, CONFIG, (ring,))
+
+
+def src(i: int) -> Endpoint:
+    return Endpoint("127.0.0.1", i)
+
+
+def test_invalid_khl_rejected():
+    for k, h, l in [(2, 1, 1), (10, 11, 4), (10, 9, 10), (10, 9, 0)]:
+        with pytest.raises(ValueError):
+            MultiNodeCutDetector(k, h, l)
+
+
+def test_cut_detection_single_node():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst = Endpoint("127.0.0.2", 2)
+    for i in range(H - 1):
+        ret = wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i))
+        assert ret == []
+        assert wb.get_num_proposals() == 0
+    ret = wb.aggregate_for_proposal(alert(src(H), dst, EdgeStatus.UP, H - 1))
+    assert len(ret) == 1
+    assert wb.get_num_proposals() == 1
+
+
+def test_cut_detection_blocked_by_one_blocker():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst1 = Endpoint("127.0.0.2", 2)
+    dst2 = Endpoint("127.0.0.3", 2)
+    for dst in (dst1, dst2):
+        for i in range(H - 1):
+            assert wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    # dst1 crosses H while dst2 is still in flux: blocked
+    assert wb.aggregate_for_proposal(alert(src(H), dst1, EdgeStatus.UP, H - 1)) == []
+    assert wb.get_num_proposals() == 0
+    # dst2 crosses H: both emitted as one cut
+    ret = wb.aggregate_for_proposal(alert(src(H), dst2, EdgeStatus.UP, H - 1))
+    assert len(ret) == 2
+    assert wb.get_num_proposals() == 1
+
+
+def test_cut_detection_blocked_by_three_blockers():
+    wb = MultiNodeCutDetector(K, H, L)
+    dsts = [Endpoint(f"127.0.0.{i}", 2) for i in (2, 3, 4)]
+    for dst in dsts:
+        for i in range(H - 1):
+            assert wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    assert wb.aggregate_for_proposal(alert(src(H), dsts[0], EdgeStatus.UP, H - 1)) == []
+    assert wb.aggregate_for_proposal(alert(src(H), dsts[2], EdgeStatus.UP, H - 1)) == []
+    assert wb.get_num_proposals() == 0
+    ret = wb.aggregate_for_proposal(alert(src(H), dsts[1], EdgeStatus.UP, H - 1))
+    assert len(ret) == 3
+    assert wb.get_num_proposals() == 1
+
+
+def test_cut_detection_multiple_blockers_past_h():
+    wb = MultiNodeCutDetector(K, H, L)
+    dsts = [Endpoint(f"127.0.0.{i}", 2) for i in (2, 3, 4)]
+    for dst in dsts:
+        for i in range(H - 1):
+            assert wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    # extra (duplicate-ring) reports past H for dst1 and dst3 change nothing
+    wb.aggregate_for_proposal(alert(src(H), dsts[0], EdgeStatus.UP, H - 1))
+    assert wb.aggregate_for_proposal(alert(src(H + 1), dsts[0], EdgeStatus.UP, H - 1)) == []
+    wb.aggregate_for_proposal(alert(src(H), dsts[2], EdgeStatus.UP, H - 1))
+    assert wb.aggregate_for_proposal(alert(src(H + 1), dsts[2], EdgeStatus.UP, H - 1)) == []
+    assert wb.get_num_proposals() == 0
+    ret = wb.aggregate_for_proposal(alert(src(H), dsts[1], EdgeStatus.UP, H - 1))
+    assert len(ret) == 3
+    assert wb.get_num_proposals() == 1
+
+
+def test_cut_detection_below_l_not_blocking():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst1 = Endpoint("127.0.0.2", 2)
+    dst2 = Endpoint("127.0.0.3", 2)  # stays below L: not a blocker
+    dst3 = Endpoint("127.0.0.4", 2)
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(src(i + 1), dst1, EdgeStatus.UP, i)) == []
+    for i in range(L - 1):
+        assert wb.aggregate_for_proposal(alert(src(i + 1), dst2, EdgeStatus.UP, i)) == []
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(src(i + 1), dst3, EdgeStatus.UP, i)) == []
+    assert wb.aggregate_for_proposal(alert(src(H), dst1, EdgeStatus.UP, H - 1)) == []
+    ret = wb.aggregate_for_proposal(alert(src(H), dst3, EdgeStatus.UP, H - 1))
+    assert len(ret) == 2
+    assert wb.get_num_proposals() == 1
+
+
+def test_cut_detection_batch():
+    wb = MultiNodeCutDetector(K, H, L)
+    endpoints = [Endpoint("127.0.0.2", 2 + i) for i in range(3)]
+    proposal = []
+    for endpoint in endpoints:
+        for ring in range(K):
+            proposal.extend(
+                wb.aggregate_for_proposal(alert(src(1), endpoint, EdgeStatus.UP, ring))
+            )
+    assert len(proposal) == 3
+
+
+def test_cut_detection_link_invalidation():
+    """Mixed failure scenario: dst stuck at H-1 reports; its remaining
+    observers themselves fail. invalidate_failing_edges() implicitly reports
+    the missing edges and unsticks the cut (CutDetectionTest.java:254-301)."""
+    view = MembershipView(K)
+    wb = MultiNodeCutDetector(K, H, L)
+    endpoints = [Endpoint("127.0.0.2", 2 + i) for i in range(30)]
+    for n in endpoints:
+        view.ring_add(n, fresh_id())
+
+    dst = endpoints[0]
+    observers = view.get_observers_of(dst)
+    assert len(observers) == K
+
+    # alerts from observers[0 .. H-1) about dst
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(observers[i], dst, EdgeStatus.DOWN, i)) == []
+
+    # alerts *about* observers[H-1 .. K) (themselves fully reported)
+    failed_observers = set()
+    for i in range(H - 1, K):
+        observers_of_observer = view.get_observers_of(observers[i])
+        failed_observers.add(observers[i])
+        for j in range(K):
+            assert wb.aggregate_for_proposal(
+                alert(observers_of_observer[j], observers[i], EdgeStatus.DOWN, j)
+            ) == []
+    assert wb.get_num_proposals() == 0
+
+    ret = wb.invalidate_failing_edges(view)
+    assert len(ret) == 4
+    assert wb.get_num_proposals() == 1
+    for node in ret:
+        assert node in failed_observers or node == dst

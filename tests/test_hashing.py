@@ -1,0 +1,64 @@
+"""Differential tests: python-int hash == numpy limb hash == jnp limb hash."""
+import numpy as np
+import pytest
+
+from rapid_tpu import hashing as H
+
+
+def _rand_u64(rng, n):
+    return rng.integers(0, 1 << 64, size=n, dtype=np.uint64)
+
+
+def test_splitmix64_known_values():
+    # splitmix64(seed=0) first outputs, from the public reference sequence
+    # (Steele et al., "Fast Splittable Pseudorandom Number Generators").
+    assert H.splitmix64(0) == 0xE220A8397B1DCDAF
+    assert H.splitmix64(H.splitmix64(0) ^ 0) != H.splitmix64(0)
+
+
+def test_limbs_roundtrip():
+    rng = np.random.default_rng(0)
+    xs = _rand_u64(rng, 100)
+    hi, lo = H.np_to_limbs(xs)
+    assert np.array_equal(H.np_from_limbs(hi, lo), xs)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 9, 0xDEADBEEF, (1 << 64) - 1])
+def test_numpy_limbs_match_python(seed):
+    rng = np.random.default_rng(42)
+    xs = _rand_u64(rng, 256)
+    hi, lo = H.np_to_limbs(xs)
+    rhi, rlo = H.hash64_limbs(np, hi, lo, seed=seed)
+    got = H.np_from_limbs(rhi, rlo)
+    want = np.array([H.hash64(int(x), seed) for x in xs], dtype=np.uint64)
+    assert np.array_equal(got, want)
+
+
+def test_jnp_limbs_match_numpy():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    xs = _rand_u64(rng, 512)
+    hi, lo = H.np_to_limbs(xs)
+    for seed in (0, 3, 123456789):
+        nhi, nlo = H.hash64_limbs(np, hi, lo, seed=seed)
+        jhi, jlo = H.hash64_limbs(jnp, jnp.asarray(hi), jnp.asarray(lo), seed=seed)
+        assert np.array_equal(np.asarray(jhi), nhi)
+        assert np.array_equal(np.asarray(jlo), nlo)
+
+
+def test_mul32_wide_exhaustive_edges():
+    edge = np.array(
+        [0, 1, 2, 0xFFFF, 0x10000, 0x7FFFFFFF, 0x80000000, 0xFFFFFFFE, 0xFFFFFFFF],
+        dtype=np.uint32,
+    )
+    a = np.repeat(edge, len(edge))
+    b = np.tile(edge, len(edge))
+    hi, lo = H.mul32_wide(np, a, b)
+    prod = a.astype(object) * b.astype(object)
+    assert np.array_equal(hi.astype(object) * (1 << 32) + lo.astype(object), prod)
+
+
+def test_fingerprint_bytes_distinct():
+    seen = {H.fingerprint_bytes(f"host-{i}".encode()) for i in range(10000)}
+    assert len(seen) == 10000

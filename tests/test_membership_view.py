@@ -1,0 +1,236 @@
+"""Oracle MembershipView tests, mirroring the reference MembershipViewTest.java
+scenario matrix (SURVEY.md §4.1)."""
+import pytest
+
+from rapid_tpu.oracle import (
+    MembershipView,
+    NodeAlreadyInRingError,
+    NodeNotInRingError,
+    UUIDAlreadySeenError,
+)
+from rapid_tpu.types import Endpoint, NodeId
+
+K = 10
+_id_counter = 0
+
+
+def fresh_id() -> NodeId:
+    global _id_counter
+    _id_counter += 1
+    return NodeId(0xABCD_0000 + _id_counter, _id_counter * 7919)
+
+
+def ep(i: int, host: str = "127.0.0.1") -> Endpoint:
+    return Endpoint(host, i)
+
+
+def test_one_ring_addition():
+    view = MembershipView(K)
+    addr = ep(123)
+    view.ring_add(addr, fresh_id())
+    for k in range(K):
+        ring = view.get_ring(k)
+        assert ring == [addr]
+
+
+def test_multiple_ring_additions():
+    view = MembershipView(K)
+    for i in range(10):
+        view.ring_add(ep(i), fresh_id())
+    for k in range(K):
+        assert len(view.get_ring(k)) == 10
+
+
+def test_ring_readditions_rejected():
+    view = MembershipView(K)
+    for i in range(10):
+        view.ring_add(ep(i), fresh_id())
+    for i in range(10):
+        with pytest.raises(NodeAlreadyInRingError):
+            view.ring_add(ep(i), fresh_id())
+
+
+def test_ring_deletions_of_absent_nodes_rejected():
+    view = MembershipView(K)
+    for i in range(10):
+        with pytest.raises(NodeNotInRingError):
+            view.ring_delete(ep(i))
+
+
+def test_ring_additions_and_deletions():
+    view = MembershipView(K)
+    for i in range(10):
+        view.ring_add(ep(i), fresh_id())
+    for i in range(10):
+        view.ring_delete(ep(i))
+    for k in range(K):
+        assert view.get_ring(k) == []
+
+
+def test_monitoring_relationship_single_node_and_absent():
+    view = MembershipView(K)
+    n1 = ep(1)
+    view.ring_add(n1, fresh_id())
+    assert view.get_subjects_of(n1) == []
+    assert view.get_observers_of(n1) == []
+
+    n2 = ep(2)
+    with pytest.raises(NodeNotInRingError):
+        view.get_subjects_of(n2)
+    with pytest.raises(NodeNotInRingError):
+        view.get_observers_of(n2)
+
+
+def test_monitoring_relationship_empty_view():
+    view = MembershipView(K)
+    with pytest.raises(NodeNotInRingError):
+        view.get_subjects_of(ep(1))
+    with pytest.raises(NodeNotInRingError):
+        view.get_observers_of(ep(1))
+
+
+def test_monitoring_relationship_two_nodes():
+    view = MembershipView(K)
+    n1, n2 = ep(1), ep(2)
+    view.ring_add(n1, fresh_id())
+    view.ring_add(n2, fresh_id())
+    assert len(view.get_subjects_of(n1)) == K
+    assert len(view.get_observers_of(n1)) == K
+    assert len(set(view.get_subjects_of(n1))) == 1
+    assert len(set(view.get_observers_of(n1))) == 1
+
+
+def test_monitoring_relationship_three_nodes_with_delete():
+    view = MembershipView(K)
+    n1, n2, n3 = ep(1), ep(2), ep(3)
+    for n in (n1, n2, n3):
+        view.ring_add(n, fresh_id())
+    assert len(view.get_subjects_of(n1)) == K
+    assert len(view.get_observers_of(n1)) == K
+    assert len(set(view.get_subjects_of(n1))) == 2
+    assert len(set(view.get_observers_of(n1))) == 2
+    view.ring_delete(n2)
+    assert len(view.get_subjects_of(n1)) == K
+    assert len(view.get_observers_of(n1)) == K
+    assert len(set(view.get_subjects_of(n1))) == 1
+    assert len(set(view.get_observers_of(n1))) == 1
+
+
+def test_monitoring_relationship_multiple_nodes():
+    view = MembershipView(K)
+    nodes = [ep(i) for i in range(1000)]
+    for n in nodes:
+        view.ring_add(n, fresh_id())
+    for n in nodes[:100]:
+        assert len(view.get_subjects_of(n)) == K
+        assert len(view.get_observers_of(n)) == K
+
+
+def test_observer_subject_duality():
+    """If s is a subject of o on ring k, then o is an observer of s on ring k."""
+    view = MembershipView(K)
+    nodes = [ep(i) for i in range(50)]
+    for n in nodes:
+        view.ring_add(n, fresh_id())
+    for o in nodes:
+        subjects = view.get_subjects_of(o)
+        for k, s in enumerate(subjects):
+            assert view.get_observers_of(s)[k] == o
+
+
+def test_monitoring_relationship_bootstrap():
+    view = MembershipView(K)
+    n = ep(1234)
+    view.ring_add(n, fresh_id())
+    joiner = ep(1235)
+    expected = view.get_expected_observers_of(joiner)
+    assert len(expected) == K
+    assert set(expected) == {n}
+
+
+def test_monitoring_relationship_bootstrap_multiple():
+    view = MembershipView(K)
+    joiner = ep(1233)
+    for i in range(20):
+        view.ring_add(ep(1234 + i), fresh_id())
+        # gatekeeper list always has one entry per ring
+        assert len(view.get_expected_observers_of(joiner)) == K
+    # with 20 nodes the K gatekeepers should be mostly distinct
+    assert K - 3 <= len(set(view.get_expected_observers_of(joiner))) <= K
+
+
+def test_node_unique_id_no_deletions():
+    view = MembershipView(K)
+    n1 = ep(1)
+    id1 = fresh_id()
+    view.ring_add(n1, id1)
+
+    # same host, same id
+    with pytest.raises(UUIDAlreadySeenError):
+        view.ring_add(ep(1), NodeId(id1.high, id1.low))
+    # same host, different id
+    with pytest.raises(NodeAlreadyInRingError):
+        view.ring_add(ep(1), fresh_id())
+    # different host, same id
+    n3 = ep(2)
+    with pytest.raises(UUIDAlreadySeenError):
+        view.ring_add(n3, NodeId(id1.high, id1.low))
+    # different host, different id: fine
+    view.ring_add(n3, fresh_id())
+    assert len(view.get_ring(0)) == 2
+
+
+def test_node_unique_id_with_deletions():
+    view = MembershipView(K)
+    n1, n2 = ep(1), ep(2)
+    id2 = fresh_id()
+    view.ring_add(n1, fresh_id())
+    view.ring_add(n2, id2)
+    view.ring_delete(n2)
+    assert len(view.get_ring(0)) == 1
+    # rejoin with the same id is rejected; a fresh id works
+    with pytest.raises(UUIDAlreadySeenError):
+        view.ring_add(n2, NodeId(id2.high, id2.low))
+    view.ring_add(n2, fresh_id())
+    assert len(view.get_ring(0)) == 2
+
+
+def test_node_configuration_change():
+    view = MembershipView(K)
+    seen = set()
+    for i in range(1000):
+        view.ring_add(ep(i), NodeId(i, i))
+        seen.add(view.get_current_configuration_id())
+    assert len(seen) == 1000
+
+
+def test_node_configurations_across_views():
+    """Same nodes added in opposite orders: all intermediate configuration ids
+    differ, the final ones agree (order-independence of the fingerprint)."""
+    v1, v2 = MembershipView(K), MembershipView(K)
+    n = 1000
+    ids1, ids2 = [], []
+    for i in range(n):
+        v1.ring_add(ep(i), NodeId(i, i))
+        ids1.append(v1.get_current_configuration_id())
+    for i in reversed(range(n)):
+        v2.ring_add(ep(i), NodeId(i, i))
+        ids2.append(v2.get_current_configuration_id())
+    assert all(a != b for a, b in zip(ids1[:-1], ids2[:-1]))
+    assert ids1[-1] == ids2[-1]
+
+
+def test_configuration_snapshot_roundtrip():
+    """A Configuration snapshot bootstraps an identical view (the checkpoint
+    format; reference MembershipView.java:443-462)."""
+    view = MembershipView(K)
+    for i in range(64):
+        view.ring_add(ep(i), NodeId(i * 3, i * 5))
+    cfg = view.get_configuration()
+    assert cfg.get_configuration_id() == view.get_current_configuration_id()
+    restored = MembershipView(K, cfg.node_ids, cfg.endpoints)
+    assert restored.get_current_configuration_id() == view.get_current_configuration_id()
+    for k in range(K):
+        assert restored.get_ring(k) == view.get_ring(k)
+    for n in view.get_ring(0)[:10]:
+        assert restored.get_observers_of(n) == view.get_observers_of(n)

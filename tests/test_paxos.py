@@ -1,0 +1,316 @@
+"""Consensus tests, mirroring the reference PaxosTests.java scenario matrix:
+fallback recovery, classic-round learning of fast-round results, and the
+exhaustive coordinator-rule tables with 100 shuffled quorums per case."""
+import random
+
+import pytest
+
+from rapid_tpu.oracle.paxos import FastPaxos, Paxos
+from rapid_tpu.oracle.testkit import (
+    DirectBroadcaster,
+    DirectMessagingClient,
+    ManualScheduler,
+    NoOpBroadcaster,
+    NoOpClient,
+)
+from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage, Phase1bMessage, Rank
+
+MAX_INT = 2**31 - 1
+
+
+def hosts(*specs):
+    return tuple(Endpoint.parse(s) for s in specs)
+
+
+P1 = hosts("127.0.0.1:5891", "127.0.0.1:5821")
+P2 = hosts("127.0.0.1:5821", "127.0.0.1:5872")
+NOISE = hosts("127.0.0.1:1", "127.0.0.1:2")
+
+
+def make_instances(n, on_decide, drop_types=None, seed=123):
+    instances = {}
+    client = DirectMessagingClient(instances, drop_types=drop_types or set())
+    broadcaster = DirectBroadcaster(instances, client)
+    scheduler = ManualScheduler()
+    rng = random.Random(seed)
+    for i in range(n):
+        addr = Endpoint("127.0.0.1", 1234 + i)
+        instances[addr] = FastPaxos(
+            addr, configuration_id=1, membership_size=n, client=client,
+            broadcaster=broadcaster, scheduler=scheduler,
+            on_decide=lambda hosts_, a=addr: on_decide(a, hosts_),
+            rng=rng,
+        )
+    return instances, scheduler, client
+
+
+@pytest.mark.parametrize("num_nodes", [5, 6, 10, 11, 20])
+def test_recovery_for_single_propose(num_nodes):
+    """One node proposes; the fast round can't reach quorum, so its fallback
+    classic round drives everyone to the proposed value."""
+    decisions = {}
+    instances, scheduler, _ = make_instances(num_nodes, decisions.__setitem__)
+    proposal = list(hosts("172.14.12.3:1234"))
+    first = next(iter(instances.values()))
+    first.propose(proposal, recovery_delay_ticks=5)
+    assert decisions == {}
+    scheduler.advance_by(10)
+    assert len(decisions) == num_nodes
+    assert all(d == proposal for d in decisions.values())
+
+
+@pytest.mark.parametrize("num_nodes", [5, 6, 10, 11, 20])
+def test_recovery_from_fast_round_with_different_proposals(num_nodes):
+    """Every node proposes its own address: conflicting fast round, classic
+    fallback converges everyone on one of the proposed values."""
+    decisions = {}
+    instances, scheduler, _ = make_instances(num_nodes, decisions.__setitem__)
+    for addr, fp in instances.items():
+        fp.propose([addr], recovery_delay_ticks=10)
+    scheduler.advance_by(1000)
+    assert len(decisions) == num_nodes
+    values = {tuple(d) for d in decisions.values()}
+    assert len(values) == 1
+    decided = next(iter(values))
+    assert len(decided) == 1
+    assert decided[0] in instances
+
+
+@pytest.mark.parametrize("num_nodes", [5, 6, 10, 11, 20])
+def test_classic_round_after_successful_fast_round(num_nodes):
+    """Fast-round messages all lost, but every node voted (locally) for the
+    same value; a classic round must learn that result."""
+    decisions = {}
+    instances, scheduler, client = make_instances(
+        num_nodes, decisions.__setitem__, drop_types={FastRoundPhase2bMessage}
+    )
+    proposal = list(hosts("127.0.0.1:1234"))
+    for fp in instances.values():
+        fp.propose(proposal, recovery_delay_ticks=10**9)
+    assert decisions == {}
+    for fp in instances.values():
+        fp.start_classic_paxos_round()
+    assert len(decisions) == num_nodes
+    assert all(d == proposal for d in decisions.values())
+
+
+@pytest.mark.parametrize(
+    "num_nodes,p1,p2,p2_votes,choices",
+    [
+        (6, P1, P2, 5, (P2,)),
+        (6, P1, P2, 1, (P1,)),
+        (6, P1, P2, 4, (P1, P2)),
+        (6, P1, P2, 2, (P1, P2)),
+        (5, P1, P2, 4, (P2,)),
+        (5, P1, P2, 1, (P1,)),
+        (10, P1, P2, 4, (P1, P2)),
+        (10, P1, P2, 1, (P1, P2)),
+    ],
+)
+def test_classic_round_after_fast_round_mixed_values(num_nodes, p1, p2, p2_votes, choices):
+    """Mixed fast-round votes lost in transit; classic round must pick a value
+    consistent with the Fast Paxos coordinator rule."""
+    decisions = {}
+    instances, scheduler, client = make_instances(
+        num_nodes, decisions.__setitem__, drop_types={FastRoundPhase2bMessage}
+    )
+    for i, fp in enumerate(instances.values()):
+        fp.propose(list(p1 if i < num_nodes - p2_votes else p2),
+                   recovery_delay_ticks=10**9)
+    assert decisions == {}
+    for fp in instances.values():
+        fp.start_classic_paxos_round()
+    assert len(decisions) == num_nodes
+    values = {tuple(d) for d in decisions.values()}
+    assert len(values) == 1
+    assert next(iter(values)) in choices
+
+
+def _phase1b(vrnd: Rank, vval, config=1):
+    return Phase1bMessage(Endpoint("0.0.0.0", 0), config, rnd=Rank(0, 0),
+                          vrnd=vrnd, vval=tuple(vval))
+
+
+COORDINATOR_CASES = [
+    # (N, p1_count@rank(1,1), p2_count@rank(0,MAX), proposals, valid indices)
+    (6, 4, 2, (P1, P2, NOISE), {0}),
+    (6, 5, 1, (P1, P2, NOISE), {0}),
+    (6, 6, 0, (P1, P2, NOISE), {0}),
+    (9, 6, 3, (P1, P2, NOISE), {0, 1}),
+    (9, 7, 2, (P1, P2, NOISE), {0}),
+    (9, 8, 1, (P1, P2, NOISE), {0}),
+    (6, 1, 5, (P1, P2, NOISE), {0, 1}),
+    (6, 2, 4, (P1, P2, NOISE), {0, 1}),
+    (6, 3, 3, (P1, P2, NOISE), {0}),
+    (6, 3, 3, (P2, P1, NOISE), {0}),
+    (6, 4, 1, (P1, P2, NOISE), {0}),
+    (6, 5, 1, (P1, P2, NOISE), {0}),
+    (9, 6, 1, (P1, P2, NOISE), {0, 1, 2}),
+    (9, 7, 1, (P1, P2, NOISE), {0}),
+    (9, 8, 1, (P1, P2, NOISE), {0}),
+    (6, 1, 2, (P1, P2, NOISE), {0, 1, 2}),
+    (6, 2, 1, (P1, P2, NOISE), {0, 1, 2}),
+    (6, 3, 0, (P1, P2, NOISE), {0}),
+    (6, 3, 0, (P2, P1, NOISE), {0}),
+]
+
+
+@pytest.mark.parametrize("n,p1n,p2n,proposals,valid", COORDINATOR_CASES)
+def test_coordinator_rule(n, p1n, p2n, proposals, valid):
+    """Value selection with proposals at different ranks
+    (PaxosTests.java coordinatorRuleTests tables)."""
+    valid_values = {proposals[i] for i in valid}
+    rng = random.Random(n * 1000 + p1n * 100 + p2n)
+    paxos = Paxos(Endpoint("127.0.0.1", 1234), 1, n, NoOpClient(),
+                  NoOpBroadcaster(), lambda _: None)
+    for _ in range(100):
+        messages = (
+            [_phase1b(Rank(1, 1), proposals[0]) for _ in range(p1n)]
+            + [_phase1b(Rank(0, MAX_INT), proposals[1]) for _ in range(p2n)]
+            + [_phase1b(Rank(0, i), NOISE) for i in range(p1n + p2n, n)]
+        )
+        rng.shuffle(messages)
+        quorum = messages[: n // 2 + 1]
+        chosen = paxos.select_proposal_using_coordinator_rule(quorum)
+        assert chosen in valid_values, f"chose {chosen}"
+
+
+SAME_RANK_CASES = [
+    (6, 4, 2, (P1, P2, NOISE), {0, 1}),
+    (6, 5, 1, (P1, P2, NOISE), {0}),
+    (6, 6, 0, (P1, P2, NOISE), {0}),
+    (9, 6, 3, (P1, P2, NOISE), {0, 1}),
+    (9, 7, 2, (P1, P2, NOISE), {0}),
+    (9, 8, 1, (P1, P2, NOISE), {0}),
+    (6, 3, 3, (P1, P2, NOISE), {0, 1}),
+    (6, 3, 3, (P2, P1, NOISE), {0, 1}),
+    (6, 4, 1, (P1, P2, NOISE), {0, 1}),
+    (6, 5, 0, (P1, P2, NOISE), {0}),
+    (9, 6, 1, (P1, P2, NOISE), {0, 1, 2}),
+    (9, 7, 1, (P1, P2, NOISE), {0}),
+    (9, 8, 1, (P1, P2, NOISE), {0}),
+    (6, 1, 2, (P1, P2, NOISE), {0, 1, 2}),
+    (6, 2, 1, (P1, P2, NOISE), {0, 1, 2}),
+    (6, 3, 0, (P1, P2, NOISE), {0}),
+    (6, 3, 0, (P2, P1, NOISE), {0}),
+]
+
+
+@pytest.mark.parametrize("n,p1n,p2n,proposals,valid", SAME_RANK_CASES)
+def test_coordinator_rule_same_rank(n, p1n, p2n, proposals, valid):
+    """Value selection with two proposals at the same (highest) rank
+    (PaxosTests.java coordinatorRuleTestsSameRank tables)."""
+    valid_values = {proposals[i] for i in valid}
+    rng = random.Random(n * 1000 + p1n * 100 + p2n + 7)
+    paxos = Paxos(Endpoint("127.0.0.1", 1234), 1, n, NoOpClient(),
+                  NoOpBroadcaster(), lambda _: None)
+    top = Rank(1, 1)
+    for _ in range(100):
+        messages = (
+            [_phase1b(top, proposals[0]) for _ in range(p1n)]
+            + [_phase1b(top, proposals[1]) for _ in range(p2n)]
+            + [_phase1b(Rank(0, i), proposals[2]) for i in range(p1n + p2n, n)]
+        )
+        rng.shuffle(messages)
+        quorum = messages[: n // 2 + 1]
+        chosen = paxos.select_proposal_using_coordinator_rule(quorum)
+        assert chosen in valid_values, f"chose {chosen}"
+
+
+# ---------------------------------------------------------------------------
+# Fast-round quorum tables (FastPaxosWithoutFallbackTests.java:85-148)
+# ---------------------------------------------------------------------------
+
+FAST_QUORUM_TABLE = [
+    (6, 5), (48, 37), (50, 38), (100, 76), (102, 77),   # even N
+    (5, 4), (51, 39), (49, 37), (99, 75), (101, 76),    # odd N
+]
+
+
+def _fast_paxos_single(n, on_decide):
+    addr = Endpoint("127.0.0.1", 1234)
+    return FastPaxos(addr, configuration_id=1, membership_size=n,
+                     client=NoOpClient(), broadcaster=NoOpBroadcaster(),
+                     scheduler=ManualScheduler(), on_decide=on_decide)
+
+
+@pytest.mark.parametrize("n,quorum", FAST_QUORUM_TABLE)
+def test_fast_quorum_no_conflicts(n, quorum):
+    assert quorum == n - (n - 1) // 4
+    decided = []
+    fp = _fast_paxos_single(n, decided.append)
+    proposal = hosts("127.0.0.1:1235")
+    for i in range(quorum - 1):
+        fp.handle_messages(
+            FastRoundPhase2bMessage(Endpoint("127.0.0.2", i), 1, proposal)
+        )
+        assert decided == []
+    fp.handle_messages(
+        FastRoundPhase2bMessage(Endpoint("127.0.0.2", quorum - 1), 1, proposal)
+    )
+    assert decided == [list(proposal)]
+
+
+FAST_QUORUM_CONFLICTS = [
+    # (N, quorum, conflicts, decision expected)
+    (6, 5, 1, True), (48, 37, 1, True), (50, 38, 1, True),
+    (100, 76, 1, True), (102, 77, 1, True),
+    (48, 37, 11, True), (50, 38, 12, True), (100, 76, 24, True), (102, 77, 25, True),
+    (6, 5, 2, False), (48, 37, 14, False), (50, 38, 13, False),
+    (100, 76, 25, False), (102, 77, 26, False),
+]
+
+
+@pytest.mark.parametrize("n,quorum,conflicts,change_expected", FAST_QUORUM_CONFLICTS)
+def test_fast_quorum_with_conflicts(n, quorum, conflicts, change_expected):
+    decided = []
+    fp = _fast_paxos_single(n, decided.append)
+    proposal = hosts("127.0.0.1:1235")
+    conflict = hosts("127.0.0.1:1236")
+    for i in range(conflicts):
+        fp.handle_messages(
+            FastRoundPhase2bMessage(Endpoint("127.0.0.2", i), 1, conflict)
+        )
+        assert decided == []
+    non_conflict_count = min(conflicts + quorum - 1, n - 1)
+    for i in range(conflicts, non_conflict_count):
+        fp.handle_messages(
+            FastRoundPhase2bMessage(Endpoint("127.0.0.2", i), 1, proposal)
+        )
+        assert decided == []
+    fp.handle_messages(
+        FastRoundPhase2bMessage(Endpoint("127.0.0.2", non_conflict_count), 1, proposal)
+    )
+    assert (decided == [list(proposal)]) == change_expected
+    # stale-configuration and duplicate-sender votes are ignored
+    fp.handle_messages(FastRoundPhase2bMessage(Endpoint("127.0.0.3", 999), 2, proposal))
+
+
+def test_straggler_fallback_after_fast_decision_is_idempotent():
+    """A node partitioned during the fast round falls back to a classic round
+    after the others already decided; duplicate decisions must be ignored."""
+    decisions = {}
+
+    def on_decide(addr, value):
+        assert addr not in decisions, "double decision delivered"
+        decisions[addr] = value
+
+    instances, scheduler, client = make_instances(5, on_decide)
+    addrs = list(instances)
+    straggler = addrs[-1]
+    proposal = list(hosts("127.0.0.9:1"))
+
+    # fast votes from everyone but the straggler reach everyone but the straggler
+    client.drop_types.add(FastRoundPhase2bMessage)
+    instances[straggler].propose(proposal, recovery_delay_ticks=50)
+    client.drop_types.remove(FastRoundPhase2bMessage)
+    for a in addrs[:-1]:
+        orig = client.instances.pop(straggler)
+        instances[a].propose(proposal, recovery_delay_ticks=10**9)
+        client.instances[straggler] = orig
+    assert len(decisions) == 4  # quorum 5 - 1 = 4 reached without straggler
+
+    # straggler's fallback fires: classic round completes against decided nodes
+    scheduler.advance_by(100)
+    assert len(decisions) == 5
+    assert all(v == proposal for v in decisions.values())

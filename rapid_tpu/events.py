@@ -1,0 +1,33 @@
+"""Cluster event subscription types.
+
+Reference: ClusterEvents.java:19-24, ClusterStatusChange.java:20-49,
+NodeStatusChange.java:26-40.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from rapid_tpu.types import EdgeStatus, Endpoint, Metadata
+
+
+class ClusterEvents(enum.Enum):
+    VIEW_CHANGE_PROPOSAL = 0
+    VIEW_CHANGE = 1
+    VIEW_CHANGE_ONE_STEP_FAILED = 2  # declared (as in the reference), never fired
+    KICKED = 3
+
+
+@dataclass(frozen=True)
+class NodeStatusChange:
+    endpoint: Endpoint
+    status: EdgeStatus
+    metadata: Tuple[Tuple[str, bytes], ...] = ()
+
+
+@dataclass(frozen=True)
+class ClusterStatusChange:
+    configuration_id: int
+    membership: Tuple[Endpoint, ...]
+    status_changes: Tuple[NodeStatusChange, ...]

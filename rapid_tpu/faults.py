@@ -1,0 +1,216 @@
+"""Fault-injection models (the fault-matrix library).
+
+The reference injects faults test-side only: a blacklist failure detector,
+server-side message-drop interceptors, and process kills (SURVEY.md §4.5).
+Here fault injection is a first-class library shared by the host oracle and
+the TPU engine:
+
+- the oracle queries ``edge_ok(src, dst, tick)`` / ``is_crashed(node, tick)``
+  per event;
+- the engine materializes the same model as boolean edge-mask tensors per
+  tick (``rapid_tpu.engine`` calls ``edge_mask(slot_of, tick, capacity)``).
+
+Determinism: models are pure functions of (src, dst, tick) plus a seed —
+probabilistic drops hash the (seed, src-uid, dst-uid, tick) tuple via
+splitmix64, so host and device sample identical faults without sharing RNG
+state.
+
+Models mirror the ATC'18 evaluation scenarios (BASELINE.md): crashes,
+probabilistic packet loss (ingress-side, "80% loss on 1% of processes"),
+asymmetric one-way partitions ("firewall" rules), flip-flopping reachability
+(20 s on/off), and correlated rack failure.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from rapid_tpu import hashing
+from rapid_tpu.oracle.membership_view import uid_of
+from rapid_tpu.types import Endpoint
+
+
+class FaultModel:
+    """Base: a healthy network."""
+
+    def is_crashed(self, node: Endpoint, tick: int) -> bool:
+        return False
+
+    def edge_ok(self, src: Endpoint, dst: Endpoint, tick: int) -> bool:
+        """Whether a message sent src -> dst delivered at ``tick`` survives."""
+        return True
+
+    # -- engine-facing: materialize masks for a slot universe ----------------
+
+    def crash_mask(self, endpoints: Sequence[Endpoint], tick: int) -> np.ndarray:
+        """bool[n]: True = crashed at tick."""
+        return np.array([self.is_crashed(e, tick) for e in endpoints], dtype=bool)
+
+    def edge_mask(self, endpoints: Sequence[Endpoint], tick: int) -> np.ndarray:
+        """bool[n, n]: [s, d] True = deliverable src->dst at tick (network
+        only; crashes are applied separately)."""
+        n = len(endpoints)
+        mask = np.ones((n, n), dtype=bool)
+        for i, s in enumerate(endpoints):
+            for j, d in enumerate(endpoints):
+                if not self.edge_ok(s, d, tick):
+                    mask[i, j] = False
+        return mask
+
+
+HEALTHY = FaultModel()
+
+
+@dataclass
+class CrashFault(FaultModel):
+    """Nodes crash (fail-stop) at given ticks: {endpoint: crash_tick}."""
+
+    crashes: Dict[Endpoint, int] = field(default_factory=dict)
+
+    def is_crashed(self, node: Endpoint, tick: int) -> bool:
+        t = self.crashes.get(node)
+        return t is not None and tick >= t
+
+    def crash_mask(self, endpoints, tick):
+        ticks = np.array([self.crashes.get(e, np.iinfo(np.int64).max)
+                          for e in endpoints])
+        return ticks <= tick
+
+
+@dataclass
+class PacketDropFault(FaultModel):
+    """Probabilistic drop with probability p on edges into/out of a target
+    set (or everywhere if no targets). ``ingress``: drop on edges *into* a
+    target (the paper's ingress-loss experiment); ``egress`` likewise."""
+
+    p: float = 0.0
+    targets: Optional[FrozenSet[Endpoint]] = None
+    ingress: bool = True
+    egress: bool = True
+    seed: int = 0
+
+    def _applies(self, src: Endpoint, dst: Endpoint) -> bool:
+        if self.targets is None:
+            return True
+        return (self.ingress and dst in self.targets) or \
+               (self.egress and src in self.targets)
+
+    def edge_ok(self, src: Endpoint, dst: Endpoint, tick: int) -> bool:
+        if not self._applies(src, dst):
+            return True
+        return not _bernoulli(self.seed, uid_of(src), uid_of(dst), tick, self.p)
+
+    def edge_mask(self, endpoints, tick):
+        uids = np.array([uid_of(e) for e in endpoints], dtype=np.uint64)
+        drop = _bernoulli_matrix(self.seed, uids, tick, self.p)
+        if self.targets is not None:
+            t = np.array([e in self.targets for e in endpoints], dtype=bool)
+            applies = np.zeros((len(endpoints), len(endpoints)), dtype=bool)
+            if self.ingress:
+                applies |= t[None, :]
+            if self.egress:
+                applies |= t[:, None]
+            drop &= applies
+        return ~drop
+
+
+@dataclass
+class OneWayPartitionFault(FaultModel):
+    """Asymmetric 'firewall': messages from sources in ``from_set`` to
+    destinations in ``to_set`` are dropped (one direction only)."""
+
+    from_set: FrozenSet[Endpoint] = frozenset()
+    to_set: FrozenSet[Endpoint] = frozenset()
+    start_tick: int = 0
+    end_tick: int = 1 << 62
+
+    def edge_ok(self, src: Endpoint, dst: Endpoint, tick: int) -> bool:
+        if not (self.start_tick <= tick < self.end_tick):
+            return True
+        return not (src in self.from_set and dst in self.to_set)
+
+
+@dataclass
+class FlipFlopFault(FaultModel):
+    """Reachability of a target set oscillates: unreachable (both directions)
+    for ``period_ticks``, then reachable for ``period_ticks``, repeating —
+    the paper's one-way flip-flop uses an inner one-way rule."""
+
+    targets: FrozenSet[Endpoint] = frozenset()
+    period_ticks: int = 200
+    start_tick: int = 0
+    one_way: bool = True  # drop only *into* targets during the off phase
+
+    def _off_phase(self, tick: int) -> bool:
+        if tick < self.start_tick:
+            return False
+        return ((tick - self.start_tick) // self.period_ticks) % 2 == 0
+
+    def edge_ok(self, src: Endpoint, dst: Endpoint, tick: int) -> bool:
+        if not self._off_phase(tick):
+            return True
+        if dst in self.targets and src not in self.targets:
+            return False
+        if not self.one_way and src in self.targets and dst not in self.targets:
+            return False
+        return True
+
+
+@dataclass
+class ComposedFault(FaultModel):
+    """Intersection of several fault models (all must allow delivery)."""
+
+    models: List[FaultModel] = field(default_factory=list)
+
+    def is_crashed(self, node, tick):
+        return any(m.is_crashed(node, tick) for m in self.models)
+
+    def edge_ok(self, src, dst, tick):
+        return all(m.edge_ok(src, dst, tick) for m in self.models)
+
+    def crash_mask(self, endpoints, tick):
+        mask = np.zeros(len(endpoints), dtype=bool)
+        for m in self.models:
+            mask |= m.crash_mask(endpoints, tick)
+        return mask
+
+    def edge_mask(self, endpoints, tick):
+        mask = np.ones((len(endpoints), len(endpoints)), dtype=bool)
+        for m in self.models:
+            mask &= m.edge_mask(endpoints, tick)
+        return mask
+
+
+def correlated_rack_failure(endpoints: Sequence[Endpoint], rack_of: Callable[[Endpoint], int],
+                            failed_racks: Set[int], crash_tick: int) -> CrashFault:
+    """All nodes in the failed racks crash simultaneously at ``crash_tick``."""
+    return CrashFault({e: crash_tick for e in endpoints if rack_of(e) in failed_racks})
+
+
+# ---------------------------------------------------------------------------
+# Deterministic Bernoulli sampling shared host/device
+# ---------------------------------------------------------------------------
+
+_P_SCALE = float(1 << 32)
+
+
+def _bernoulli(seed: int, src_uid: int, dst_uid: int, tick: int, p: float) -> bool:
+    h = hashing.hash64(
+        src_uid ^ hashing.hash64(dst_uid, seed=tick & hashing.MASK64),
+        seed=seed ^ 0xD809F,
+    )
+    return (h >> 32) < int(p * _P_SCALE)
+
+
+def _bernoulli_matrix(seed: int, uids: np.ndarray, tick: int, p: float) -> np.ndarray:
+    """bool[n, n] of drop decisions; [s, d] matches _bernoulli(s, d)."""
+    dhi, dlo = hashing.np_to_limbs(uids)
+    thi, tlo = hashing.hash64_limbs(np, dhi, dlo, seed=tick & hashing.MASK64)
+    th = hashing.np_from_limbs(thi, tlo)
+    x = uids[:, None] ^ th[None, :]
+    xhi, xlo = hashing.np_to_limbs(x.reshape(-1))
+    rhi, rlo = hashing.hash64_limbs(np, xhi, xlo, seed=seed ^ 0xD809F)
+    h = rhi.astype(np.uint64).reshape(len(uids), len(uids))
+    return h < np.uint64(int(p * _P_SCALE))

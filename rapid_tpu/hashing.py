@@ -83,13 +83,13 @@ def mul32_wide(xp, a, b):
     p01 = a0 * b1
     p10 = a1 * b0
     p11 = a1 * b1
-    # mid = p01 + p10 + (p00 >> 16): may carry into bit 33
+    # mid = p01 + (p00 >> 16) cannot wrap uint32 (max 0xFFFEFFFF); only the
+    # subsequent + p10 can carry into bit 32.
     mid = p01 + (p00 >> xp.uint32(16))
-    carry1 = (mid < p01).astype(xp.uint32)  # wrapped?
     mid2 = mid + p10
-    carry2 = (mid2 < p10).astype(xp.uint32)
+    carry = (mid2 < p10).astype(xp.uint32)
     lo = (p00 & xp.uint32(0xFFFF)) | (mid2 << xp.uint32(16))
-    hi = p11 + (mid2 >> xp.uint32(16)) + ((carry1 + carry2) << xp.uint32(16))
+    hi = p11 + (mid2 >> xp.uint32(16)) + (carry << xp.uint32(16))
     return hi, lo
 
 

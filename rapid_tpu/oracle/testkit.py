@@ -50,7 +50,9 @@ class ManualScheduler(IScheduler):
         while self._heap and self._heap[0][0] <= tick:
             due, handle, fn = heapq.heappop(self._heap)
             self._now = due
-            if handle not in self._cancelled:
+            if handle in self._cancelled:
+                self._cancelled.discard(handle)
+            else:
                 fn()
         self._now = tick
 
@@ -123,11 +125,11 @@ class StaticFailureDetector(IEdgeFailureDetectorFactory):
 
     def create_instance(self, subject: Endpoint,
                         notify: Callable[[], None]) -> Callable[[], None]:
-        notified = [False]
-
+        # Re-notifies on every FD interval while blacklisted, like the
+        # reference (StaticFailureDetector.java:39-44) — repeated alerts are
+        # deduplicated downstream by the cut detector.
         def run() -> None:
-            if subject in self.failed_nodes and not notified[0]:
-                notified[0] = True
+            if subject in self.failed_nodes:
                 notify()
 
         return run

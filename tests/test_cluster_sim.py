@@ -1,0 +1,280 @@
+"""Whole-cluster simulation tests in one process, mirroring the reference
+ClusterTest.java scenario matrix (SURVEY.md §4.4): sequential and parallel
+joins, crash faults detected by the real probe-based FD, bulk failures,
+concurrent join+failure, graceful leave, and kick notification."""
+import pytest
+
+from rapid_tpu.events import ClusterEvents
+from rapid_tpu.faults import CrashFault, ComposedFault, OneWayPartitionFault
+from rapid_tpu.oracle.cluster import Cluster
+from rapid_tpu.oracle.simulation import SimNetwork
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint
+
+SETTINGS = Settings()
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("10.0.0.1", 1234 + i)
+
+
+def make_network(fault_model=None, settings=SETTINGS) -> SimNetwork:
+    if fault_model is None:
+        return SimNetwork(settings)
+    return SimNetwork(settings, fault_model)
+
+
+def wait_until(network: SimNetwork, predicate, max_ticks: int = 1000) -> bool:
+    for _ in range(max_ticks):
+        if predicate():
+            return True
+        network.step()
+    return predicate()
+
+
+def boot_cluster(network: SimNetwork, n: int, parallel: bool = False,
+                 settings=SETTINGS):
+    """Seed at ep(0); n-1 joiners; returns the list of Cluster objects."""
+    clusters = [Cluster(network, ep(0), settings).start()]
+    joiners = []
+    for i in range(1, n):
+        c = Cluster(network, ep(i), settings)
+        joiners.append(c)
+    if parallel:
+        for c in joiners:
+            c.join(ep(0))
+        ok = wait_until(
+            network,
+            lambda: all(c.is_active for c in joiners)
+            and all(c.get_membership_size() == n for c in joiners + clusters),
+            max_ticks=3000,
+        )
+        assert ok, "parallel joins did not converge"
+    else:
+        for c in joiners:
+            c.join(ep(0))
+            assert wait_until(network, lambda: c.is_active, 500), \
+                f"{c.listen_address} failed to join"
+    clusters.extend(joiners)
+    return clusters
+
+
+def verify_agreement(clusters, expected_size=None):
+    active = [c for c in clusters if c.is_active]
+    lists = {tuple(c.get_memberlist()) for c in active}
+    assert len(lists) == 1, f"views diverged: {len(lists)} distinct"
+    configs = {c.get_configuration_id() for c in active}
+    assert len(configs) == 1
+    if expected_size is not None:
+        assert len(next(iter(lists))) == expected_size
+
+
+def test_single_node_start():
+    network = make_network()
+    c = Cluster(network, ep(0)).start()
+    assert c.get_membership_size() == 1
+    assert c.get_memberlist() == [ep(0)]
+
+
+def test_single_join():
+    network = make_network()
+    seed = Cluster(network, ep(0)).start()
+    joiner = Cluster(network, ep(1)).join(ep(0))
+    assert wait_until(network, lambda: joiner.is_active, 200)
+    verify_agreement([seed, joiner], expected_size=2)
+
+
+@pytest.mark.parametrize("n", [5, 10, 20])
+def test_sequential_joins(n):
+    network = make_network()
+    clusters = boot_cluster(network, n)
+    assert wait_until(
+        network,
+        lambda: all(c.get_membership_size() == n for c in clusters), 300)
+    verify_agreement(clusters, expected_size=n)
+
+
+@pytest.mark.parametrize("n", [10, 21])
+def test_parallel_joins(n):
+    network = make_network()
+    clusters = boot_cluster(network, n, parallel=True)
+    verify_agreement(clusters, expected_size=n)
+
+
+def test_join_with_metadata():
+    network = make_network()
+    Cluster(network, ep(0), metadata={"role": b"seed"}).start()
+    joiner = Cluster(network, ep(1), metadata={"role": b"worker"}).join(ep(0))
+    assert wait_until(network, lambda: joiner.is_active, 200)
+    md = joiner.get_cluster_metadata()
+    assert md.get(ep(1), {}).get("role") == b"worker"
+    # seed's metadata travels to the joiner through the join response
+    assert md.get(ep(0), {}).get("role") == b"seed"
+
+
+def test_crash_one_of_five():
+    crash = CrashFault()
+    network = make_network(crash)
+    clusters = boot_cluster(network, 5)
+    victim = clusters[2]
+    crash.crashes[victim.listen_address] = network.tick + 1
+
+    survivors = clusters[:2] + clusters[3:]
+    ok = wait_until(
+        network,
+        lambda: all(c.get_membership_size() == 4 for c in survivors),
+        max_ticks=3000,
+    )
+    assert ok, "crash was not detected and removed"
+    verify_agreement(survivors, expected_size=4)
+    assert victim.listen_address not in survivors[0].get_memberlist()
+
+
+def test_crash_quarter_of_twenty():
+    crash = CrashFault()
+    network = make_network(crash)
+    n = 20
+    clusters = boot_cluster(network, n)
+    victims = clusters[3:8:1][:5]
+    for v in victims:
+        crash.crashes[v.listen_address] = network.tick + 1
+    survivors = [c for c in clusters if c not in victims]
+    ok = wait_until(
+        network,
+        lambda: all(c.get_membership_size() == n - len(victims)
+                    for c in survivors),
+        max_ticks=5000,
+    )
+    assert ok, "bulk crash was not fully removed"
+    verify_agreement(survivors, expected_size=n - len(victims))
+
+
+def test_view_change_events_fire():
+    network = make_network()
+    seed = Cluster(network, ep(0))
+    events = []
+    seed.register_subscription(
+        ClusterEvents.VIEW_CHANGE, lambda c: events.append(c))
+    seed.start()
+    assert len(events) == 1  # initial view
+    joiner = Cluster(network, ep(1)).join(ep(0))
+    assert wait_until(network, lambda: joiner.is_active, 200)
+    assert len(events) == 2
+    assert set(events[-1].membership) == {ep(0), ep(1)}
+
+
+def test_graceful_leave():
+    network = make_network()
+    clusters = boot_cluster(network, 5)
+    leaver = clusters[4]
+    leaver.leave_gracefully()
+    survivors = clusters[:4]
+    ok = wait_until(
+        network,
+        lambda: all(c.get_membership_size() == 4 for c in survivors),
+        max_ticks=2000,
+    )
+    assert ok, "graceful leave was not propagated"
+    verify_agreement(survivors, expected_size=4)
+
+
+def test_one_way_partition_removes_only_target():
+    """Asymmetric 'firewall': node cannot be probed (ingress blocked); the
+    cluster should remove exactly that node (paper Fig. 9 behavior)."""
+    n = 8
+    partition = OneWayPartitionFault()
+    network = make_network(partition)
+    clusters = boot_cluster(network, n)
+    target = clusters[3].listen_address
+    partition.from_set = frozenset(
+        c.listen_address for c in clusters if c.listen_address != target)
+    partition.to_set = frozenset({target})
+    partition.start_tick = network.tick + 1
+
+    survivors = [c for c in clusters if c.listen_address != target]
+    ok = wait_until(
+        network,
+        lambda: all(c.get_membership_size() == n - 1 for c in survivors),
+        max_ticks=3000,
+    )
+    assert ok, "one-way partition target not removed"
+    verify_agreement(survivors, expected_size=n - 1)
+    assert target not in survivors[0].get_memberlist()
+
+
+def test_kicked_node_gets_notified():
+    """Survivors' failure detectors blacklist a healthy victim (injected via
+    the public FD SPI, like the reference's StaticFailureDetector). The
+    network stays healthy, so the victim receives the consensus votes,
+    decides the view change that removes it, and fires KICKED."""
+    from rapid_tpu.oracle.testkit import StaticFailureDetector
+
+    network = make_network()
+    fd = StaticFailureDetector()
+    clusters = [Cluster(network, ep(0), SETTINGS, fd_factory=fd).start()]
+    for i in range(1, 5):
+        c = Cluster(network, ep(i), SETTINGS, fd_factory=fd).join(ep(0))
+        assert wait_until(network, lambda: c.is_active, 500)
+        clusters.append(c)
+
+    victim = clusters[2]
+    kicked = []
+    victim.register_subscription(ClusterEvents.KICKED, kicked.append)
+    fd.add_failed_nodes([victim.listen_address])
+
+    survivors = [c for c in clusters if c is not victim]
+    ok = wait_until(
+        network,
+        lambda: all(c.get_membership_size() == 4 for c in survivors)
+        and len(kicked) > 0,
+        max_ticks=3000,
+    )
+    assert ok, "victim was never told it was kicked"
+    verify_agreement(survivors, expected_size=4)
+
+
+def test_concurrent_join_and_crash():
+    crash = CrashFault()
+    network = make_network(crash)
+    n = 10
+    clusters = boot_cluster(network, n)
+    victim = clusters[5]
+    crash.crashes[victim.listen_address] = network.tick + 1
+    late_joiner = Cluster(network, ep(100)).join(ep(0))
+
+    survivors = [c for c in clusters if c is not victim]
+    ok = wait_until(
+        network,
+        lambda: late_joiner.is_active
+        and all(c.get_membership_size() == n for c in survivors + [late_joiner]),
+        max_ticks=5000,
+    )
+    assert ok, "concurrent join+crash did not converge"
+    verify_agreement(survivors + [late_joiner], expected_size=n)
+    members = survivors[0].get_memberlist()
+    assert victim.listen_address not in members
+    assert ep(100) in members
+
+
+def test_ingress_packet_loss_removes_only_target():
+    """80% ingress packet loss on one node (paper Fig. 10): the lossy node
+    should be removed, and only it."""
+    from rapid_tpu.faults import PacketDropFault
+
+    n = 8
+    drop = PacketDropFault(p=0.0, ingress=True, egress=False, seed=7)
+    network = make_network(drop)
+    clusters = boot_cluster(network, n)
+    target = clusters[4].listen_address
+    drop.p = 0.8
+    drop.targets = frozenset({target})
+
+    survivors = [c for c in clusters if c.listen_address != target]
+    ok = wait_until(
+        network,
+        lambda: all(c.get_membership_size() == n - 1 for c in survivors),
+        max_ticks=6000,
+    )
+    assert ok, "lossy node not removed"
+    verify_agreement(survivors, expected_size=n - 1)
+    assert target not in survivors[0].get_memberlist()

@@ -1,0 +1,119 @@
+"""Benchmark the jitted tick engine: simulated-gossip-rounds/sec.
+
+Runs an N-node crash-burst scenario through ``rapid_tpu.engine.simulate``
+(one jit-compiled ``lax.scan`` dispatch for the whole run) and reports
+throughput. One *gossip round* is one failure-detector interval — the
+period in which every node probes each unique subject once — i.e.
+``fd_interval_ticks`` simulated ticks.
+
+The BASELINE.json metric is rounds/sec at N=100k:
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_engine.py --n 100000
+
+Emits one BENCH-style JSON object (with trailing newline) on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np  # noqa: E402
+
+
+def synthetic_uids(n: int) -> np.ndarray:
+    """Distinct 64-bit node identities without hashing n hostnames."""
+    from rapid_tpu import hashing
+
+    hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF)
+    return hashing.np_from_limbs(hi, lo)
+
+
+def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
+        settings) -> dict:
+    import jax
+
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.step import simulate
+
+    uids = synthetic_uids(n)
+    boot_start = time.perf_counter()
+    state = init_state(uids, id_fp_sum=0, settings=settings)
+    jax.block_until_ready(state)
+    boot_s = time.perf_counter() - boot_start
+
+    n_crash = max(1, int(n * crash_frac))
+    crash_ticks = [I32_MAX] * n
+    for slot in range(0, n, max(1, n // n_crash)):
+        crash_ticks[slot] = crash_tick
+    faults = crash_faults(crash_ticks)
+
+    # First call compiles (trace + XLA); second call measures steady state.
+    compile_start = time.perf_counter()
+    final, logs = simulate(state, faults, ticks, settings)
+    jax.block_until_ready((final, logs))
+    compile_s = time.perf_counter() - compile_start
+
+    run_start = time.perf_counter()
+    final, logs = simulate(state, faults, ticks, settings)
+    jax.block_until_ready((final, logs))
+    wall_s = time.perf_counter() - run_start
+
+    decisions = int(np.asarray(logs.decide_now).sum())
+    announces = int(np.asarray(logs.announce_now).sum())
+    ticks_per_sec = ticks / wall_s
+    return {
+        "bench": "engine_tick",
+        "platform": jax.default_backend(),
+        "n": n,
+        "k": settings.K,
+        "ticks": ticks,
+        "crashed_nodes": int(np.sum(np.asarray(crash_ticks) != I32_MAX)),
+        "boot_s": round(boot_s, 4),
+        "compile_s": round(compile_s, 4),
+        "wall_s": round(wall_s, 4),
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "rounds_per_sec": round(ticks_per_sec / settings.fd_interval_ticks, 2),
+        "announcements": announces,
+        "decisions": decisions,
+        "final_members": int(np.asarray(final.member).sum()),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--n", type=int, default=10_000,
+                        help="simulated cluster size (default 10k)")
+    parser.add_argument("--ticks", type=int, default=50,
+                        help="simulated ticks per run (default 50)")
+    parser.add_argument("--k", type=int, default=10, help="rings (default 10)")
+    parser.add_argument("--crash-frac", type=float, default=0.01,
+                        help="fraction of nodes crashing (default 1%%)")
+    parser.add_argument("--crash-tick", type=int, default=5,
+                        help="tick of the correlated crash burst")
+    parser.add_argument("--sweep", action="store_true",
+                        help="run the BASELINE sweep n in {1k, 10k, 100k}")
+    args = parser.parse_args(argv)
+
+    from rapid_tpu.settings import Settings
+
+    settings = Settings(K=args.k)
+    sizes = [1_000, 10_000, 100_000] if args.sweep else [args.n]
+    results = [run(n, args.ticks, args.crash_frac, args.crash_tick, settings)
+               for n in sizes]
+    payload = results[0] if len(results) == 1 else {"bench": "engine_tick",
+                                                    "sweep": results}
+    # BENCH artifacts end with a newline (ADVICE.md round-5 nit).
+    sys.stdout.write(json.dumps(payload, indent=2) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,31 @@
+"""Batched JAX tick engine: all N simulated nodes advance as arrays.
+
+The host oracle (``rapid_tpu.oracle``) runs the protocol one event at a
+time; the engine runs the same steady-state pipeline — K-ring probe
+monitoring, multi-node cut detection, Fast Paxos fast-round vote counting —
+as one jit-compiled step over ``[capacity]``-shaped tensors, scanned with
+``lax.scan``. ``rapid_tpu.engine.diff`` replays crash-fault scenarios
+through both and asserts bit-identical cut decisions.
+"""
+from rapid_tpu.engine.state import (
+    EngineFaults,
+    EngineState,
+    StepLog,
+    init_state,
+    state_config_id,
+)
+from rapid_tpu.engine.step import engine_step, simulate, step, trace_count
+from rapid_tpu.engine.topology import build_topology
+
+__all__ = [
+    "EngineFaults",
+    "EngineState",
+    "StepLog",
+    "build_topology",
+    "engine_step",
+    "init_state",
+    "simulate",
+    "state_config_id",
+    "step",
+    "trace_count",
+]

@@ -1,0 +1,80 @@
+"""Cut-detection kernel: L/H watermark crossings as elementwise reductions.
+
+Mirrors ``MultiNodeCutDetector._aggregate`` over the whole membership at
+once. The oracle's per-report bookkeeping reduces to three facts about the
+per-destination distinct-ring report counts:
+
+- ``pre_proposal``  (in flux)  == destinations with count in ``[L, H)``;
+- ``proposal``                 == destinations with count ``>= H``;
+- a proposal is emitted exactly at an H-crossing while no destination is
+  in flux (``updates_in_progress == 0``).
+
+Counts only change when reports arrive, and within a delivery tick every
+alive receiver processes the identical alert stream (crash-fault envelope,
+see ``state``), so evaluating the three conditions on the end-of-tick
+counts reproduces the sequential detector's emission tick and contents.
+
+``invalidate_failing_edges`` is the fixpoint of: for every in-flux
+destination, each ring whose observer is itself in (pre-)proposal (count
+``>= L``) is implicitly reported. The oracle iterates this once per
+received batch; monotone counts make the end-of-tick fixpoint land in the
+same place (the differential harness enforces it).
+"""
+from __future__ import annotations
+
+from jax import lax
+
+from rapid_tpu.engine.state import EngineState
+
+
+def deliver_reports(xp, state: EngineState, src_alive):
+    """bool [C, K]: reports landing in the detector this tick.
+
+    ``pending_deliver[obs, j]`` says observer ``obs`` reported its ring-j
+    subject two ticks ago; re-index to (destination, ring) via ``obs_idx``
+    (the ring-j observer of dst is the unique reporter for (dst, j)) and
+    mask batches whose sender crashed before delivery — the virtual network
+    drops a message when its source is crashed at delivery time.
+    """
+    by_dst = xp.take_along_axis(state.pending_deliver, state.obs_idx, axis=0)
+    return by_dst & src_alive[state.obs_idx]
+
+
+def aggregate(xp, state: EngineState, delivered, any_receiver, settings):
+    """Apply one tick of reports; returns (reports, announce_now, proposal).
+
+    ``any_receiver`` gates on an alive node existing to process the batch
+    (the shared detector stands in for every alive receiver's copy).
+    """
+    lo, hi = settings.L, settings.H
+    gate = any_receiver & ~state.announced
+    new = delivered & state.member[:, None] & gate
+    reports = state.reports | new
+    any_new = new.any()
+
+    def fix_body(r):
+        counts = r.sum(axis=1)
+        flux = (counts >= lo) & (counts < hi)
+        obs_in_sets = (counts >= lo)[state.obs_idx]
+        add = flux[:, None] & obs_in_sets & ~r
+        return r | add
+
+    def fixpoint(r):
+        def body(carry):
+            r_cur, _ = carry
+            r_next = fix_body(r_cur)
+            return r_next, (r_next != r_cur).any()
+
+        r_final, _ = lax.while_loop(lambda c: c[1], body,
+                                    (r, xp.asarray(True)))
+        return r_final
+
+    # Only iterate the fixpoint on ticks that actually delivered reports
+    # (the oracle runs invalidate only on batch receipt).
+    reports = lax.cond(any_new, fixpoint, lambda r: r, reports)
+
+    counts = reports.sum(axis=1)
+    in_flux = ((counts >= lo) & (counts < hi)).any()
+    crossed = (counts >= hi) & state.member
+    announce_now = any_new & ~in_flux & crossed.any() & ~state.announced
+    return reports, announce_now, crossed

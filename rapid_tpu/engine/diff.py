@@ -1,0 +1,288 @@
+"""Differential harness: replay a scenario through oracle and engine.
+
+The oracle (``rapid_tpu.oracle``) is the semantic reference: N python
+objects exchanging messages one event at a time. The engine is the batched
+jax port. This module runs the *same* crash-fault scenario through both and
+compares:
+
+- **cut decisions, bit-identical**: every proposal announcement and every
+  view-change decision must agree on emission tick, membership content and
+  64-bit configuration id;
+- **per-tick message counts**: the engine logs per-tick sender/recipient
+  factors (``StepLog``); ``expand_counters`` multiplies them host-side into
+  exact sent/delivered/dropped/probe tallies that must equal the oracle
+  ``SimNetwork`` counters at every tick.
+
+Scenario envelope: crashes within one burst must share their first failing
+failure-detector tick (the smallest FD-interval multiple at/after the crash
+tick), so the whole burst is removed in a single view change. Crashes that
+straddle an FD-interval boundary split into two view changes, leaving a
+crashed-but-still-member node whose *stale* pre-view-change detector state
+(saturated counters, old broadcast membership) the engine's global
+view-change reset does not model — the counter parity check below catches
+exactly that divergence. Bursts must also be separated by enough ticks for
+the previous removal to complete (~fd_threshold * fd_interval + 3).
+
+Bootstrapping N oracle nodes through the join protocol is O(N^3) messages;
+``boot_static_cluster`` instead wires every ``MembershipService`` directly
+from a shared converged ``MembershipView`` (the same shortcut the oracle
+test-suite uses for single nodes), so differentials at N=256 run in
+seconds.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rapid_tpu.events import ClusterEvents
+from rapid_tpu.faults import HEALTHY, CrashFault, FaultModel
+from rapid_tpu.oracle.cluster import Cluster
+from rapid_tpu.oracle.membership_view import MembershipView, uid_of
+from rapid_tpu.oracle.simulation import SimNetwork
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, NodeId
+
+
+@dataclass(frozen=True)
+class ViewEvent:
+    """One protocol-visible event, in canonical (slot-index) coordinates."""
+
+    tick: int
+    kind: str               # "proposal" | "view_change"
+    config_id: int          # at fire time: pre-change for proposals,
+                            # post-change for view changes
+    slots: Tuple[int, ...]  # proposed / removed slots, ascending
+
+
+def default_endpoints(n: int) -> List[Endpoint]:
+    """Deterministic distinct endpoints for an n-node scenario."""
+    return [Endpoint(f"n{i}.sim", 5000) for i in range(n)]
+
+
+def default_node_ids(n: int) -> List[NodeId]:
+    return [NodeId(i + 1, (i + 1) * 7919) for i in range(n)]
+
+
+class _Recorder:
+    """Collects ViewEvents fired by one oracle node."""
+
+    def __init__(self, network: SimNetwork,
+                 slot_of: Dict[Endpoint, int]) -> None:
+        self._network = network
+        self._slot_of = slot_of
+        self.events: List[ViewEvent] = []
+
+    def subscribe(self, cluster: Cluster) -> None:
+        cluster.register_subscription(
+            ClusterEvents.VIEW_CHANGE_PROPOSAL, self._on("proposal"))
+        cluster.register_subscription(
+            ClusterEvents.VIEW_CHANGE, self._on("view_change"))
+
+    def _on(self, kind: str):
+        def callback(change):
+            # Endpoints joining after the static boot get slots on demand
+            # (shared dict, deterministic fire order => stable numbering).
+            slots = tuple(sorted(
+                self._slot_of.setdefault(nc.endpoint, len(self._slot_of))
+                for nc in change.status_changes))
+            self.events.append(ViewEvent(
+                self._network.tick, kind, change.configuration_id, slots))
+        return callback
+
+
+def boot_static_cluster(
+    settings: Settings,
+    endpoints: Sequence[Endpoint],
+    node_ids: Sequence[NodeId],
+    fault_model: FaultModel = HEALTHY,
+) -> Tuple[SimNetwork, List[Cluster], List[_Recorder]]:
+    """Wire one converged oracle node per endpoint, in slot order.
+
+    Slot order = service creation order, which fixes the scheduler-handle
+    order of the periodic jobs — the property that makes the oracle's
+    intra-tick alert order canonical and engine-reproducible.
+    """
+    network = SimNetwork(settings, fault_model)
+    slot_of = {e: i for i, e in enumerate(endpoints)}
+    clusters: List[Cluster] = []
+    recorders: List[_Recorder] = []
+    for ep in endpoints:
+        cluster = Cluster(network, ep, settings)
+        recorder = _Recorder(network, slot_of)
+        recorder.subscribe(cluster)
+        view = MembershipView(settings.K, list(node_ids), list(endpoints))
+        cluster._wire_service(view, {})
+        clusters.append(cluster)
+        recorders.append(recorder)
+    # The initial VIEW_CHANGE each service fires at creation is boot noise,
+    # not a protocol event: drop it from every recorder.
+    for recorder in recorders:
+        recorder.events = [e for e in recorder.events if e.tick > 0
+                           or e.kind != "view_change"]
+    return network, clusters, recorders
+
+
+def run_oracle(network: SimNetwork, n_ticks: int) -> List[Dict[str, int]]:
+    """Step the oracle ``n_ticks`` times; returns per-tick counter dicts."""
+    per_tick: List[Dict[str, int]] = []
+    for _ in range(n_ticks):
+        network.step()
+        per_tick.append(network.last_tick_counters.as_dict())
+    return per_tick
+
+
+def oracle_events(
+    recorders: Sequence[_Recorder],
+    alive_slots: Sequence[int],
+) -> List[ViewEvent]:
+    """The canonical oracle event stream.
+
+    Every never-crashed node must have seen the identical stream (they
+    process identical alert/vote traffic under crash faults); asserts that
+    and returns one copy.
+    """
+    assert alive_slots, "need at least one alive node to define the stream"
+    reference = recorders[alive_slots[0]].events
+    for slot in alive_slots[1:]:
+        assert recorders[slot].events == reference, (
+            f"oracle node {slot} diverged from node {alive_slots[0]}: "
+            f"{recorders[slot].events} != {reference}")
+    return list(reference)
+
+
+def engine_events(logs) -> List[ViewEvent]:
+    """Extract the engine's event stream from stacked StepLogs."""
+    ticks = np.asarray(logs.tick)
+    ann = np.asarray(logs.announce_now)
+    dec = np.asarray(logs.decide_now)
+    proposal = np.asarray(logs.proposal)
+    decision = np.asarray(logs.decision)
+    cfg_hi = np.asarray(logs.config_hi).astype(np.uint64)
+    cfg_lo = np.asarray(logs.config_lo).astype(np.uint64)
+    cfg = (cfg_hi << np.uint64(32)) | cfg_lo
+    events: List[ViewEvent] = []
+    for i in range(len(ticks)):
+        if ann[i]:
+            events.append(ViewEvent(
+                int(ticks[i]), "proposal", int(cfg[i]),
+                tuple(int(s) for s in np.nonzero(proposal[i])[0])))
+        if dec[i]:
+            events.append(ViewEvent(
+                int(ticks[i]), "view_change", int(cfg[i]),
+                tuple(int(s) for s in np.nonzero(decision[i])[0])))
+    return events
+
+
+def expand_counters(logs) -> List[Dict[str, int]]:
+    """Per-tick exact message counts from the engine's StepLog factors.
+
+    Products are computed in python ints (a 100k-node broadcast tick is
+    10^10 messages — far past int32, which is why the engine logs factors).
+    ``dropped`` at tick t is what came due at t and was not delivered:
+    last tick's sends minus this tick's deliveries, per traffic class.
+    """
+    flushers = np.asarray(logs.flushers)
+    flush_rcpt = np.asarray(logs.flush_recipients)
+    flush_alive = np.asarray(logs.flushers_alive)
+    deliver_alive = np.asarray(logs.deliver_alive)
+    vote_send = np.asarray(logs.vote_senders)
+    vote_rcpt = np.asarray(logs.vote_recipients)
+    vote_alive = np.asarray(logs.vote_senders_alive)
+    vote_deliver = np.asarray(logs.vote_deliver_alive)
+    probes_sent = np.asarray(logs.probes_sent)
+    probes_failed = np.asarray(logs.probes_failed)
+
+    out: List[Dict[str, int]] = []
+    prev_batch_sent = 0
+    prev_vote_sent = 0
+    for i in range(len(flushers)):
+        batch_sent = int(flushers[i]) * int(flush_rcpt[i])
+        vote_sent = int(vote_send[i]) * int(vote_rcpt[i])
+        batch_delivered = int(flush_alive[i]) * int(deliver_alive[i])
+        vote_delivered = int(vote_alive[i]) * int(vote_deliver[i])
+        out.append({
+            "sent": batch_sent + vote_sent,
+            "delivered": batch_delivered + vote_delivered,
+            "dropped": (prev_batch_sent - batch_delivered)
+                       + (prev_vote_sent - vote_delivered),
+            "timeouts": 0,
+            "probes_sent": int(probes_sent[i]),
+            "probes_failed": int(probes_failed[i]),
+        })
+        prev_batch_sent = batch_sent
+        prev_vote_sent = vote_sent
+    return out
+
+
+@dataclass
+class DiffResult:
+    n: int
+    n_ticks: int
+    oracle_events: List[ViewEvent]
+    engine_events: List[ViewEvent]
+    oracle_counters: List[Dict[str, int]]
+    engine_counters: List[Dict[str, int]]
+    oracle_config_id: int
+    engine_config_id: int
+
+    def assert_identical(self) -> None:
+        assert self.engine_events == self.oracle_events, (
+            f"event streams diverged:\n engine: {self.engine_events}\n"
+            f" oracle: {self.oracle_events}")
+        for t, (eng, orc) in enumerate(zip(self.engine_counters,
+                                           self.oracle_counters), start=1):
+            assert eng == orc, (
+                f"message counters diverged at tick {t}:\n"
+                f" engine: {eng}\n oracle: {orc}")
+        assert self.engine_config_id == self.oracle_config_id, (
+            f"final configuration ids diverged: "
+            f"{self.engine_config_id:#x} != {self.oracle_config_id:#x}")
+
+
+def run_differential(
+    n: int,
+    crash_ticks: Dict[int, int],
+    n_ticks: int,
+    settings: Optional[Settings] = None,
+) -> DiffResult:
+    """Replay a crash scenario through oracle and engine and collect both.
+
+    ``crash_ticks`` maps slot index -> crash tick. Call
+    ``result.assert_identical()`` for the bit-identical checks.
+    """
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.state import state_config_id
+    from rapid_tpu.engine.step import simulate
+
+    settings = settings or Settings()
+    endpoints = default_endpoints(n)
+    node_ids = default_node_ids(n)
+
+    # --- oracle side ----------------------------------------------------
+    fault_model = CrashFault({endpoints[s]: t for s, t in crash_ticks.items()})
+    network, clusters, recorders = boot_static_cluster(
+        settings, endpoints, node_ids, fault_model)
+    oracle_counts = run_oracle(network, n_ticks)
+    alive = [s for s in range(n) if s not in crash_ticks]
+    events_oracle = oracle_events(recorders, alive)
+    oracle_cfg = clusters[alive[0]].membership_service.view \
+        .get_current_configuration_id()
+
+    # --- engine side ----------------------------------------------------
+    uids = [uid_of(e) for e in endpoints]
+    id_fp_sum = clusters[0].membership_service.view._id_fp_sum
+    state = init_state(uids, id_fp_sum, settings)
+    faults = crash_faults([crash_ticks.get(s, I32_MAX) for s in range(n)])
+    final_state, logs = simulate(state, faults, n_ticks, settings)
+
+    return DiffResult(
+        n=n, n_ticks=n_ticks,
+        oracle_events=events_oracle,
+        engine_events=engine_events(logs),
+        oracle_counters=oracle_counts,
+        engine_counters=expand_counters(logs),
+        oracle_config_id=oracle_cfg,
+        engine_config_id=state_config_id(final_state),
+    )

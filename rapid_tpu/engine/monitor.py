@@ -1,0 +1,85 @@
+"""Monitoring kernel: batched probe outcomes and tombstone counters.
+
+Reproduces ``PingPongFailureDetector`` semantics over the whole ``[C, K]``
+edge array at once. Per failure-detector tick (global ticks ``t`` with
+``t % fd_interval == 0`` and ``t > fd_gate`` — the oracle aligns every
+node's FD job to global tick multiples):
+
+- a slot at/over the failure threshold notifies exactly once (the oracle
+  checks the threshold *before* probing, so a saturated detector never
+  probes again);
+- every other active slot probes its subject: the probe fails if the
+  subject or the observer is crashed, or the fault model drops the
+  observer->subject edge (the oracle's synchronous probe fast path
+  evaluates reachability at probe time with exactly these checks);
+- failed probes increment the per-edge tombstone counter.
+
+A notification fans out to *all* rings covered by that unique subject via
+``fd_first``, mirroring ``get_ring_numbers`` in the oracle's DOWN alert.
+"""
+from __future__ import annotations
+
+from rapid_tpu import hashing
+from rapid_tpu.engine.state import EngineFaults, EngineState
+
+
+def crashed_at(faults: EngineFaults, tick):
+    """bool [C]: crashed at ``tick`` (crash_tick <= tick)."""
+    return faults.crash_tick <= tick
+
+
+def edge_drop(xp, faults: EngineFaults, src_idx, dst_idx, uid_hi, uid_lo, tick):
+    """bool with the shape of ``src_idx``: fault model drops src->dst now.
+
+    Bit-matches ``faults._bernoulli``: drop iff the high 32 bits of
+    ``hash64(src_uid ^ hash64(dst_uid, seed=tick), seed=drop_seed ^ 0xD809F)``
+    are below ``p * 2^32``. ``drop_p`` is static, so the healthy case
+    compiles to nothing.
+    """
+    if faults.drop_p <= 0.0:
+        return xp.zeros(src_idx.shape, bool)
+    dhi, dlo = uid_hi[dst_idx], uid_lo[dst_idx]
+    t32 = tick.astype(xp.uint32)
+    thi, tlo = hashing.hash64_limbs_dynseed(
+        xp, dhi, dlo, xp.zeros_like(t32), t32)
+    xhi = uid_hi[src_idx] ^ thi
+    xlo = uid_lo[src_idx] ^ tlo
+    rhi, _ = hashing.hash64_limbs(xp, xhi, xlo,
+                                  seed=faults.drop_seed ^ 0xD809F)
+    drop = rhi < xp.uint32(int(faults.drop_p * float(1 << 32)) & 0xFFFFFFFF)
+    if faults.drop_targets is not None:
+        applies = xp.zeros(src_idx.shape, bool)
+        if faults.drop_ingress:
+            applies |= faults.drop_targets[dst_idx]
+        if faults.drop_egress:
+            applies |= faults.drop_targets[src_idx]
+        drop &= applies
+    return drop
+
+
+def monitor_tick(xp, state: EngineState, faults: EngineFaults, settings):
+    """One FD interval for every node at once.
+
+    Returns (fc, notified, notify_expanded, probes_sent, probes_failed):
+    ``notify_expanded`` is the ``[C, K]`` per-(observer, ring) alert mask to
+    feed the flush pipeline.
+    """
+    t = state.tick
+    crashed = crashed_at(faults, t)
+    obs_slots = xp.arange(state.fc.shape[0], dtype=xp.int32)[:, None]
+    subj = state.subj_idx
+    probe_fail = (crashed[subj] | crashed[:, None]
+                  | edge_drop(xp, faults, xp.broadcast_to(obs_slots, subj.shape),
+                              subj, state.uid_hi, state.uid_lo, t))
+
+    at_threshold = state.fc >= settings.fd_failure_threshold
+    probing = state.fd_active & ~at_threshold
+    notify_now = state.fd_active & at_threshold & ~state.notified
+    notified = state.notified | notify_now
+    fc = xp.where(probing & probe_fail, state.fc + 1, state.fc)
+
+    # Fan the unique-subject notification out to every ring it covers.
+    notify_expanded = xp.take_along_axis(notify_now, state.fd_first, axis=1)
+    probes_sent = probing.sum().astype(xp.int32)
+    probes_failed = (probing & probe_fail).sum().astype(xp.int32)
+    return fc, notified, notify_expanded, probes_sent, probes_failed

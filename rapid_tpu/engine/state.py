@@ -1,0 +1,231 @@
+"""Engine array state: the slot universe and the per-tick carry.
+
+Array layout
+------------
+The engine works over a fixed *slot universe* of ``capacity`` slots, one per
+simulated node (slot order = node creation order, which for the oracle's
+static bootstrap equals endpoint order). All protocol state is slot-indexed:
+
+- identity: 64-bit node uids as ``(hi, lo)`` uint32 limb pairs (TPUs have no
+  native 64-bit ints; see ``rapid_tpu.hashing``), plus per-slot membership
+  fingerprints for the running configuration-id sums;
+- topology: ``subj_idx[n, k]`` / ``obs_idx[n, k]`` — node ``n``'s ring-``k``
+  subject (predecessor) and observer (successor) slot, recomputed from the
+  shared hash order on every view change;
+- monitoring: per unique-subject tombstone counters ``fc`` and the
+  notified-once latch, mirroring ``PingPongFailureDetector``;
+- alert pipeline: the oracle's enqueue -> flush(+1 tick) -> deliver(+1 tick)
+  path as two ``[capacity, K]`` report buffers;
+- cut detection: the per-(destination, ring) report matrix plus the
+  announced-proposal latch, mirroring ``MultiNodeCutDetector``;
+- consensus: the pending fast-round vote and its proposal fingerprint.
+
+Scenario envelope
+-----------------
+The engine reproduces the oracle bit-for-bit for *crash-fault* scenarios
+(``rapid_tpu.engine.diff`` asserts it): crashes make every alive receiver
+see the identical alert stream, so one shared cut-detector state stands in
+for all N per-node detectors. Fault models that split the receiver set
+(partitions) need per-node detector state — a roadmap item.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from rapid_tpu import hashing
+from rapid_tpu.settings import Settings
+
+I32_MAX = np.iinfo(np.int32).max
+
+
+class EngineFaults:
+    """Device-side fault model (crash + optional probabilistic probe drop).
+
+    ``crash_tick[n]`` is the tick at/after which slot ``n`` is crashed
+    (``I32_MAX`` = never). ``drop_p``/``drop_seed``/``drop_targets`` mirror
+    ``faults.PacketDropFault`` via the same splitmix64 Bernoulli draw, so a
+    future drop-scenario differential can bit-match the oracle.
+
+    Registered as a pytree with the drop *configuration* as static aux data:
+    the step function branches on ``drop_p`` in Python, so it must not be a
+    traced leaf — changing it retriggers a (cheap, rare) retrace instead.
+    """
+
+    def __init__(self, crash_tick, drop_p: float = 0.0, drop_seed: int = 0,
+                 drop_targets=None, drop_ingress: bool = True,
+                 drop_egress: bool = True) -> None:
+        self.crash_tick = crash_tick  # i32 [C]
+        self.drop_p = float(drop_p)
+        self.drop_seed = int(drop_seed)
+        self.drop_targets = drop_targets  # bool [C] or None = everywhere
+        self.drop_ingress = bool(drop_ingress)
+        self.drop_egress = bool(drop_egress)
+
+    def tree_flatten(self):
+        children = (self.crash_tick, self.drop_targets)
+        aux = (self.drop_p, self.drop_seed, self.drop_targets is None,
+               self.drop_ingress, self.drop_egress)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        crash_tick, drop_targets = children
+        drop_p, drop_seed, targets_none, ingress, egress = aux
+        return cls(crash_tick, drop_p, drop_seed,
+                   None if targets_none else drop_targets, ingress, egress)
+
+
+def _register_faults() -> None:
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        EngineFaults,
+        lambda f: f.tree_flatten(),
+        EngineFaults.tree_unflatten,
+    )
+
+
+_register_faults()
+
+
+class EngineState(NamedTuple):
+    tick: object                      # i32 scalar (absolute oracle tick)
+    member: object                    # bool [C]
+    uid_hi: object                    # u32 [C]
+    uid_lo: object                    # u32 [C]
+    mfp_hi: object                    # u32 [C] member-fingerprint limbs
+    mfp_lo: object                    # u32 [C]
+    idsum_hi: object                  # u32 scalar: identifier-fp sum
+    idsum_lo: object                  # u32 scalar
+    memsum_hi: object                 # u32 scalar: member-fp sum
+    memsum_lo: object                 # u32 scalar
+    # topology (recomputed on view change)
+    subj_idx: object                  # i32 [C, K]
+    obs_idx: object                   # i32 [C, K]
+    fd_active: object                 # bool [C, K] first-ring slot per unique subject
+    fd_first: object                  # i32 [C, K] first ring slot with same subject
+    # monitoring
+    fc: object                        # i32 [C, K] failure counters (active slots)
+    notified: object                  # bool [C, K] notified-once latch
+    fd_gate: object                   # i32 scalar: probes only at t > fd_gate
+    # alert pipeline (per observer slot x ring, already ring-expanded)
+    pending_flush: object             # bool [C, K]: notified at t, flushes t+1
+    pending_deliver: object           # bool [C, K]: flushed at t, delivers t+1
+    # cut detection (shared detector of all alive receivers)
+    reports: object                   # bool [C, K] per (dst, ring)
+    announced: object                 # bool scalar
+    proposal: object                  # bool [C] announced proposal mask
+    announce_tick: object             # i32 scalar
+    vote_pending: object              # bool scalar: votes in flight
+    voters: object                    # bool [C] who voted at announce_tick
+    phash_hi: object                  # u32 scalar proposal fingerprint
+    phash_lo: object                  # u32 scalar
+
+
+class StepLog(NamedTuple):
+    """Per-tick observable outputs collected by ``lax.scan``.
+
+    Counter fields are small per-tick *factors* (numbers of senders and
+    recipients), not products: at 100k nodes the products overflow int32 and
+    jax without x64 has no int64, so the host computes ``sent = flushers *
+    recipients`` etc. exactly in Python (see ``diff.expand_counters``).
+    """
+
+    tick: object                      # i32
+    announce_now: object              # bool
+    proposal: object                  # bool [C]
+    decide_now: object                # bool
+    decision: object                  # bool [C]
+    config_hi: object                 # u32 (config id after this tick)
+    config_lo: object                 # u32
+    n_member: object                  # i32 (after this tick)
+    probes_sent: object               # i32
+    probes_failed: object             # i32
+    flushers: object                  # i32: nodes broadcasting an alert batch
+    flush_recipients: object          # i32: membership size at flush
+    flushers_alive: object            # i32: batches surviving src-crash check
+    deliver_alive: object             # i32: alive recipients at delivery
+    vote_senders: object              # i32: nodes broadcasting a fast vote
+    vote_recipients: object           # i32
+    vote_senders_alive: object        # i32: votes surviving src-crash check
+    vote_deliver_alive: object        # i32
+
+
+def config_id_limbs(xp, idsum_hi, idsum_lo, memsum_hi, memsum_lo):
+    """Limb version of ``membership_view.configuration_id``."""
+    shi, slo = hashing.splitmix64_limbs(xp, idsum_hi, idsum_lo)
+    hi, lo = hashing.add64(xp, shi, slo, memsum_hi, memsum_lo)
+    return hashing.splitmix64_limbs(xp, hi, lo)
+
+
+def state_config_id(state: EngineState) -> int:
+    """Current configuration id of the engine state as a python int."""
+    import jax.numpy as jnp
+
+    hi, lo = config_id_limbs(jnp, state.idsum_hi, state.idsum_lo,
+                             state.memsum_hi, state.memsum_lo)
+    return hashing.from_limbs(int(hi), int(lo))
+
+
+def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
+               start_tick: int = 0) -> EngineState:
+    """Build the engine state for a fully-converged membership.
+
+    ``uids`` are the 64-bit node identities in slot order (from
+    ``membership_view.uid_of`` for oracle parity, or any synthetic uint64s
+    for benchmarks); ``id_fp_sum`` is the oracle's identifier-fingerprint
+    sum (``MembershipView._id_fp_sum``), carried so configuration ids agree.
+    """
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine.topology import build_topology
+    from rapid_tpu.oracle.membership_view import _SEED_MEMBER
+
+    uids_np = np.asarray(uids, dtype=np.uint64)
+    c = len(uids_np)
+    k = settings.K
+    uid_hi, uid_lo = hashing.np_to_limbs(uids_np)
+    mhi, mlo = hashing.hash64_limbs(np, uid_hi, uid_lo, seed=_SEED_MEMBER)
+    memsum = sum(int(h) << 32 | int(l) for h, l in zip(mhi, mlo)) & hashing.MASK64
+    idh, idl = hashing.to_limbs(id_fp_sum)
+    msh, msl = hashing.to_limbs(memsum)
+
+    member = jnp.ones((c,), bool)
+    uid_hi = jnp.asarray(uid_hi)
+    uid_lo = jnp.asarray(uid_lo)
+    subj_idx, obs_idx, fd_active, fd_first = build_topology(
+        jnp, uid_hi, uid_lo, member, k)
+    zero_ck_i = jnp.zeros((c, k), jnp.int32)
+    zero_ck_b = jnp.zeros((c, k), bool)
+    u32 = lambda v: jnp.uint32(v)
+    return EngineState(
+        tick=jnp.int32(start_tick),
+        member=member,
+        uid_hi=uid_hi, uid_lo=uid_lo,
+        mfp_hi=jnp.asarray(mhi), mfp_lo=jnp.asarray(mlo),
+        idsum_hi=u32(idh), idsum_lo=u32(idl),
+        memsum_hi=u32(msh), memsum_lo=u32(msl),
+        subj_idx=subj_idx, obs_idx=obs_idx,
+        fd_active=fd_active, fd_first=fd_first,
+        fc=zero_ck_i, notified=zero_ck_b,
+        fd_gate=jnp.int32(start_tick),
+        pending_flush=zero_ck_b, pending_deliver=zero_ck_b,
+        reports=zero_ck_b,
+        announced=jnp.asarray(False),
+        proposal=jnp.zeros((c,), bool),
+        announce_tick=jnp.int32(-1),
+        vote_pending=jnp.asarray(False),
+        voters=jnp.zeros((c,), bool),
+        phash_hi=u32(0), phash_lo=u32(0),
+    )
+
+
+def crash_faults(crash_ticks: Sequence[int]) -> EngineFaults:
+    """EngineFaults for a pure crash scenario; I32_MAX/None = never."""
+    import jax.numpy as jnp
+
+    arr = np.array([I32_MAX if t is None else t for t in crash_ticks],
+                   dtype=np.int32)
+    return EngineFaults(crash_tick=jnp.asarray(arr))

@@ -1,0 +1,206 @@
+"""The tick engine: one jitted step composing all four kernels.
+
+Phase order inside a tick mirrors the oracle's ``SimScheduler.step`` — the
+virtual network delivers messages (in send order) *before* due tasks run,
+and within the delivery phase votes (sent during the previous tick's
+delivery phase) sort before alert batches (sent during its run_due phase):
+
+1. **decide** — fast-round votes sent at the announce tick arrive; a
+   quorum triggers the view change (membership shrink, limb-subtracting
+   the removed members' fingerprints from the membership sum, topology
+   rebuild, full monitor/cut/consensus reset, FD re-alignment via
+   ``fd_gate``);
+2. **deliver** — alert batches flushed last tick land in the cut
+   detector; an H-crossing with no destination in flux announces the
+   proposal and broadcasts the fast-round votes;
+3. **flush** — batches enqueued by last FD tick move to the delivery
+   buffer (the oracle's 1-tick batching-window quiescence);
+4. **monitor** — on global ticks ``t % fd_interval == 0`` past the
+   ``fd_gate``, every node probes its unique subjects and saturated
+   counters enqueue their DOWN alerts.
+
+``step`` is pure and shape-static: ``engine_step`` is its jit, and
+``simulate`` drives it through ``lax.scan`` inside a single jit so an
+n-tick run is one device dispatch. ``trace_count()`` exposes how many
+times the step body has been traced (tests assert a single compilation).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rapid_tpu import hashing
+from rapid_tpu.engine import cut, monitor
+from rapid_tpu.engine import votes as votes_mod
+from rapid_tpu.engine.state import (EngineFaults, EngineState, StepLog,
+                                    config_id_limbs)
+from rapid_tpu.engine.topology import build_topology
+from rapid_tpu.settings import Settings
+
+_TRACE_COUNT = 0
+
+
+def trace_count() -> int:
+    """How many times the step body has been traced (re-compiled)."""
+    return _TRACE_COUNT
+
+
+def step(state: EngineState, faults: EngineFaults,
+         settings: Settings) -> tuple:
+    """Advance the engine by one tick; returns (new_state, StepLog)."""
+    global _TRACE_COUNT
+    _TRACE_COUNT += 1
+
+    t = state.tick + 1
+    crashed = monitor.crashed_at(faults, t)
+
+    # ---- phase 1: vote delivery & decision -----------------------------
+    votes_arriving = state.vote_pending & (state.announce_tick + 1 == t)
+    valid = state.voters & ~crashed & votes_arriving
+    n_member = state.member.sum().astype(jnp.int32)
+    c = state.member.shape[0]
+    decided, _ = votes_mod.count_fast_round(
+        jnp,
+        jnp.broadcast_to(state.phash_hi, (c,)),
+        jnp.broadcast_to(state.phash_lo, (c,)),
+        valid, n_member)
+    # A decision needs an alive receiver to count the votes.
+    decide_now = votes_arriving & decided & (state.member & ~crashed).any()
+    decision = state.proposal & decide_now
+
+    vote_senders_alive = jnp.where(
+        votes_arriving, valid.sum(), 0).astype(jnp.int32)
+    vote_deliver_alive = jnp.where(
+        votes_arriving, (state.member & ~crashed).sum(), 0).astype(jnp.int32)
+
+    def do_view_change(_):
+        removed = state.proposal
+        member = state.member & ~removed
+        rm = removed.astype(jnp.uint32)
+        rhi, rlo = hashing.sum64(jnp, state.mfp_hi * rm, state.mfp_lo * rm)
+        ms_hi, ms_lo = hashing.sub64(
+            jnp, state.memsum_hi, state.memsum_lo, rhi, rlo)
+        topo = build_topology(jnp, state.uid_hi, state.uid_lo, member,
+                              settings.K)
+        return (member, ms_hi, ms_lo) + topo
+
+    def keep_view(_):
+        return (state.member, state.memsum_hi, state.memsum_lo,
+                state.subj_idx, state.obs_idx, state.fd_active,
+                state.fd_first)
+
+    (member, memsum_hi, memsum_lo, subj_idx, obs_idx, fd_active,
+     fd_first) = lax.cond(decide_now, do_view_change, keep_view, None)
+
+    mid = state._replace(
+        tick=t, member=member,
+        memsum_hi=memsum_hi, memsum_lo=memsum_lo,
+        subj_idx=subj_idx, obs_idx=obs_idx,
+        fd_active=fd_active, fd_first=fd_first,
+        fc=jnp.where(decide_now, 0, state.fc),
+        notified=state.notified & ~decide_now,
+        fd_gate=jnp.where(decide_now, t, state.fd_gate),
+        pending_flush=state.pending_flush & ~decide_now,
+        pending_deliver=state.pending_deliver & ~decide_now,
+        reports=state.reports & ~decide_now,
+        announced=state.announced & ~decide_now,
+        proposal=state.proposal & ~decide_now,
+        vote_pending=state.vote_pending & ~votes_arriving,
+        voters=state.voters & ~decide_now,
+    )
+
+    # ---- phase 2: alert delivery, aggregation, announce + vote cast ----
+    src_alive = ~crashed
+    batch_src = mid.pending_deliver.any(axis=1)
+    flushers_alive = (batch_src & src_alive).sum().astype(jnp.int32)
+    n_alive = (mid.member & ~crashed).sum().astype(jnp.int32)
+    delivered = cut.deliver_reports(jnp, mid, src_alive)
+    reports, announce_now, crossed = cut.aggregate(
+        jnp, mid, delivered, n_alive > 0, settings)
+
+    ph_hi, ph_lo = votes_mod.proposal_fingerprint(
+        jnp, crossed, mid.uid_hi, mid.uid_lo)
+    mid = mid._replace(
+        reports=reports,
+        announced=mid.announced | announce_now,
+        proposal=jnp.where(announce_now, crossed, mid.proposal),
+        announce_tick=jnp.where(announce_now, t, mid.announce_tick),
+        vote_pending=mid.vote_pending | announce_now,
+        voters=jnp.where(announce_now, mid.member & ~crashed, mid.voters),
+        phash_hi=jnp.where(announce_now, ph_hi, mid.phash_hi),
+        phash_lo=jnp.where(announce_now, ph_lo, mid.phash_lo),
+    )
+    n_member_now = mid.member.sum().astype(jnp.int32)
+    vote_senders = jnp.where(announce_now, n_alive, 0).astype(jnp.int32)
+    vote_recipients = jnp.where(
+        announce_now, n_member_now, 0).astype(jnp.int32)
+
+    # ---- phase 3: batch flush (1-tick quiescence) ----------------------
+    flusher_mask = mid.pending_flush.any(axis=1)
+    flushers = flusher_mask.sum().astype(jnp.int32)
+    flush_recipients = jnp.where(
+        flusher_mask.any(), n_member_now, 0).astype(jnp.int32)
+    mid = mid._replace(pending_deliver=mid.pending_flush,
+                       pending_flush=jnp.zeros_like(mid.pending_flush))
+
+    # ---- phase 4: failure-detector interval ----------------------------
+    is_fd = (t % settings.fd_interval_ticks == 0) & (t > mid.fd_gate)
+    fc_new, notified_new, notify_exp, probes_sent, probes_failed = (
+        monitor.monitor_tick(jnp, mid, faults, settings))
+    new_state = mid._replace(
+        fc=jnp.where(is_fd, fc_new, mid.fc),
+        notified=jnp.where(is_fd, notified_new, mid.notified),
+        pending_flush=notify_exp & is_fd,
+    )
+
+    cfg_hi, cfg_lo = config_id_limbs(
+        jnp, new_state.idsum_hi, new_state.idsum_lo,
+        new_state.memsum_hi, new_state.memsum_lo)
+    log = StepLog(
+        tick=t,
+        announce_now=announce_now,
+        proposal=crossed & announce_now,
+        decide_now=decide_now,
+        decision=decision,
+        config_hi=cfg_hi, config_lo=cfg_lo,
+        n_member=n_member_now,
+        probes_sent=jnp.where(is_fd, probes_sent, 0).astype(jnp.int32),
+        probes_failed=jnp.where(is_fd, probes_failed, 0).astype(jnp.int32),
+        flushers=flushers,
+        flush_recipients=flush_recipients,
+        flushers_alive=flushers_alive,
+        deliver_alive=jnp.where(batch_src.any(), n_alive, 0).astype(jnp.int32),
+        vote_senders=vote_senders,
+        vote_recipients=vote_recipients,
+        vote_senders_alive=vote_senders_alive,
+        vote_deliver_alive=vote_deliver_alive,
+    )
+    return new_state, log
+
+
+@partial(jax.jit, static_argnums=(2,))
+def engine_step(state: EngineState, faults: EngineFaults,
+                settings: Settings) -> tuple:
+    """One jitted tick — a single device dispatch per call."""
+    return step(state, faults, settings)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _simulate(state, faults, n_ticks: int, settings: Settings):
+    def body(carry, _):
+        return step(carry, faults, settings)
+
+    return lax.scan(body, state, None, length=n_ticks)
+
+
+def simulate(state: EngineState, faults: EngineFaults, n_ticks: int,
+             settings: Settings) -> tuple:
+    """Run ``n_ticks`` engine steps as one jitted ``lax.scan``.
+
+    Returns (final_state, logs) where each ``logs`` field is stacked with
+    a leading ``n_ticks`` axis.
+    """
+    return _simulate(state, faults, int(n_ticks), settings)

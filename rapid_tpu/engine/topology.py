@@ -1,0 +1,65 @@
+"""Topology kernel: the K-ring observer/subject graph as index arrays.
+
+Each ring ``k`` orders the membership by ``hash64(uid, seed=k)`` with the
+uid as tiebreak — the *same* sort key the oracle's ``MembershipView`` uses
+(it adds the Endpoint as a final tiebreak, reachable only on a full 128-bit
+collision), computed with the *same* ``hash64_limbs`` — so ring order agrees
+by construction (SURVEY.md §7 "hash parity").
+
+Non-members sort after all members via a leading non-member key, so one
+``lexsort`` over the full slot universe yields members in ring order as a
+prefix; successors/predecessors wrap around within that prefix. Everything
+is shape-static and jit-compatible: membership changes only flip the
+``member`` mask and re-run the sort.
+"""
+from __future__ import annotations
+
+from rapid_tpu import hashing
+
+
+def build_topology(xp, uid_hi, uid_lo, member, k: int):
+    """Compute (subj_idx, obs_idx, fd_active, fd_first), each ``[C, K]``.
+
+    - ``subj_idx[n, j]``: slot of node n's ring-j subject (predecessor);
+    - ``obs_idx[n, j]``: slot of node n's ring-j observer (successor);
+    - ``fd_active[n, j]``: True on the *first* ring slot of each unique
+      subject of n — the oracle creates one failure detector per unique
+      subject (``MembershipService._create_failure_detectors`` dedupes in
+      ring order), so monitor state lives at these slots;
+    - ``fd_first[n, j]``: the first ring slot with the same subject as slot
+      j (= j itself where ``fd_active``), used to fan a notification back
+      out to every ring it covers.
+
+    Non-member rows point at themselves and are fully masked.
+    """
+    c = uid_hi.shape[0]
+    member = member.astype(bool)
+    n = member.sum().astype(xp.int32)
+    slots = xp.arange(c, dtype=xp.int32)
+    nonmember_key = (~member).astype(xp.uint32)
+
+    subj_cols = []
+    obs_cols = []
+    for ring in range(k):
+        khi, klo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=ring)
+        # last key is primary: (nonmember, key_hi, key_lo, uid_hi, uid_lo)
+        order = xp.lexsort((uid_lo, uid_hi, klo, khi, nonmember_key))
+        order = order.astype(xp.int32)
+        rank = xp.argsort(order).astype(xp.int32)  # rank[slot] = ring position
+        nn = xp.maximum(n, 1)
+        succ = order[(rank + 1) % nn]
+        pred = order[(rank - 1) % nn]
+        subj_cols.append(xp.where(member, pred, slots))
+        obs_cols.append(xp.where(member, succ, slots))
+    subj_idx = xp.stack(subj_cols, axis=1)
+    obs_idx = xp.stack(obs_cols, axis=1)
+
+    # Dedup per unique subject: slot j is active iff no earlier ring slot
+    # has the same subject. eq[n, j, i] = subj[n, j] == subj[n, i].
+    eq = subj_idx[:, :, None] == subj_idx[:, None, :]
+    earlier = xp.tril(xp.ones((k, k), bool), k=-1)[None, :, :]
+    usable = member & (n >= 2)  # a <=1-member view has no subjects
+    fd_active = ~(eq & earlier).any(axis=2) & usable[:, None]
+    # First ring slot with the same subject (argmax finds the first True).
+    fd_first = xp.argmax(eq, axis=2).astype(xp.int32)
+    return subj_idx, obs_idx, fd_active, fd_first

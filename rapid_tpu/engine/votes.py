@@ -1,0 +1,72 @@
+"""Consensus kernel: Fast Paxos fast-round vote counting.
+
+Mirrors ``FastPaxos._handle_fast_round_proposal``: every alive node that
+announced a proposal broadcasts one fast-round vote; a receiver decides
+when it has seen at least ``N - floor((N-1)/4)`` votes total (the
+ceil(3N/4) fast quorum) *and* one proposal value holds that many votes.
+
+Votes are counted as a segmented bincount over 64-bit proposal
+fingerprints: sort the (hi, lo) vote hashes, mark segment starts, and
+``segment_sum`` the valid votes — O(C log C), no [C, C] comparison matrix.
+The engine's crash-fault pipeline produces a single proposal value per
+configuration (every alive receiver aggregates the identical alert
+stream), but the counter is written for the general multi-proposal case so
+the classic-round fallback kernel (roadmap) can reuse it.
+"""
+from __future__ import annotations
+
+import jax
+
+from rapid_tpu import hashing
+
+
+def proposal_fingerprint(xp, proposal_mask, uid_hi, uid_lo):
+    """64-bit fingerprint of a proposal mask, as (hi, lo) uint32 scalars.
+
+    Order-independent sum of per-member hashes finalized with splitmix64 —
+    the same shape as the configuration-id formula, so identical proposals
+    hash identically regardless of slot order.
+    """
+    phi, plo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=0x70726F70)
+    m = proposal_mask.astype(xp.uint32)
+    shi, slo = hashing.sum64(xp, phi * m, plo * m)
+    return hashing.splitmix64_limbs(xp, shi, slo)
+
+
+def segmented_vote_count(xp, vote_hi, vote_lo, valid):
+    """i32 [C]: for each slot, the number of valid votes equal to its vote.
+
+    Invalid slots count 0. Ties are grouped by sorting on (valid, hi, lo)
+    and summing run lengths with ``segment_sum``.
+    """
+    c = vote_hi.shape[0]
+    invalid = (~valid).astype(xp.uint32)
+    order = xp.lexsort((vote_lo, vote_hi, invalid))
+    shi = vote_hi[order]
+    slo = vote_lo[order]
+    sval = valid[order]
+    prev_differs = xp.ones((c,), bool).at[1:].set(
+        (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1]))
+    seg_id = xp.cumsum(prev_differs.astype(xp.int32)) - 1
+    seg_counts = jax.ops.segment_sum(sval.astype(xp.int32), seg_id,
+                                     num_segments=c)
+    counts_sorted = seg_counts[seg_id] * sval.astype(xp.int32)
+    return xp.zeros((c,), xp.int32).at[order].set(counts_sorted)
+
+
+def fast_quorum(xp, n_member):
+    """ceil(3N/4) as the reference computes it: N - floor((N-1)/4)."""
+    return (n_member - (n_member - 1) // 4).astype(xp.int32)
+
+
+def count_fast_round(xp, vote_hi, vote_lo, valid, n_member):
+    """Returns (decided, winner_count): quorum check over delivered votes.
+
+    ``valid[n]`` marks a delivered vote from slot n; a decision needs both
+    the total delivered votes and some single value's count at quorum.
+    """
+    quorum = fast_quorum(xp, n_member)
+    per_vote = segmented_vote_count(xp, vote_hi, vote_lo, valid)
+    winner_count = per_vote.max()
+    total = valid.sum().astype(xp.int32)
+    return (total >= quorum) & (winner_count >= quorum), winner_count

@@ -46,12 +46,25 @@ class FaultModel:
 
     def crash_mask(self, endpoints: Sequence[Endpoint], tick: int) -> np.ndarray:
         """bool[n]: True = crashed at tick."""
+        if type(self).is_crashed is FaultModel.is_crashed:
+            # Healthy base: skip the per-endpoint loop entirely.
+            return np.zeros(len(endpoints), dtype=bool)
         return np.array([self.is_crashed(e, tick) for e in endpoints], dtype=bool)
 
     def edge_mask(self, endpoints: Sequence[Endpoint], tick: int) -> np.ndarray:
         """bool[n, n]: [s, d] True = deliverable src->dst at tick (network
-        only; crashes are applied separately)."""
+        only; crashes are applied separately).
+
+        The generic fallback evaluates ``edge_ok`` per (src, dst) pair —
+        O(n^2) python calls, infeasible at engine scale (100k nodes = 1e10
+        calls). Models a tick engine can drive must either not override
+        ``edge_ok`` (detected here: the healthy fast path allocates one
+        array) or provide an array-native ``edge_mask`` override, as the
+        concrete models below do.
+        """
         n = len(endpoints)
+        if type(self).edge_ok is FaultModel.edge_ok:
+            return np.ones((n, n), dtype=bool)
         mask = np.ones((n, n), dtype=bool)
         for i, s in enumerate(endpoints):
             for j, d in enumerate(endpoints):
@@ -131,6 +144,14 @@ class OneWayPartitionFault(FaultModel):
             return True
         return not (src in self.from_set and dst in self.to_set)
 
+    def edge_mask(self, endpoints, tick):
+        n = len(endpoints)
+        if not (self.start_tick <= tick < self.end_tick):
+            return np.ones((n, n), dtype=bool)
+        f = np.array([e in self.from_set for e in endpoints], dtype=bool)
+        t = np.array([e in self.to_set for e in endpoints], dtype=bool)
+        return ~(f[:, None] & t[None, :])
+
 
 @dataclass
 class FlipFlopFault(FaultModel):
@@ -156,6 +177,16 @@ class FlipFlopFault(FaultModel):
         if not self.one_way and src in self.targets and dst not in self.targets:
             return False
         return True
+
+    def edge_mask(self, endpoints, tick):
+        n = len(endpoints)
+        if not self._off_phase(tick):
+            return np.ones((n, n), dtype=bool)
+        t = np.array([e in self.targets for e in endpoints], dtype=bool)
+        blocked = ~t[:, None] & t[None, :]
+        if not self.one_way:
+            blocked |= t[:, None] & ~t[None, :]
+        return ~blocked
 
 
 @dataclass

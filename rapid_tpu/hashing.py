@@ -104,6 +104,37 @@ def xor64(ahi, alo, bhi, blo):
     return ahi ^ bhi, alo ^ blo
 
 
+def neg64(xp, hi, lo):
+    """Two's-complement negation on (hi, lo) uint32 limbs."""
+    return add64(xp, ~hi, ~lo, xp.zeros_like(hi), xp.ones_like(lo))
+
+
+def sub64(xp, ahi, alo, bhi, blo):
+    """a - b mod 2^64 on (hi, lo) uint32 limbs."""
+    nhi, nlo = neg64(xp, bhi, blo)
+    return add64(xp, ahi, alo, nhi, nlo)
+
+
+def sum64(xp, hi, lo):
+    """Sum of an array of (hi, lo) uint64 values mod 2^64, as scalar limbs.
+
+    jax without x64 has no 64-bit integers, so a plain ``sum`` cannot carry;
+    this folds the array pairwise with ``add64`` (log2(n) static steps), which
+    keeps every intermediate in uint32 limbs and is jit-friendly.
+    """
+    hi = hi.reshape(-1).astype(xp.uint32)
+    lo = lo.reshape(-1).astype(xp.uint32)
+    n = hi.shape[0]
+    while n > 1:
+        if n % 2:
+            hi = xp.concatenate([hi, xp.zeros((1,), xp.uint32)])
+            lo = xp.concatenate([lo, xp.zeros((1,), xp.uint32)])
+            n += 1
+        hi, lo = add64(xp, hi[0::2], lo[0::2], hi[1::2], lo[1::2])
+        n //= 2
+    return hi[0], lo[0]
+
+
 def shr64(xp, hi, lo, n: int):
     """Logical right shift by constant 0 < n < 64."""
     assert 0 < n < 64
@@ -150,6 +181,18 @@ def hash64_limbs(xp, hi, lo, seed: int = 0):
     hi2 = hi.astype(xp.uint32) ^ _u32(xp, s >> 32)
     lo2 = lo.astype(xp.uint32) ^ _u32(xp, s)
     return splitmix64_limbs(xp, hi2, lo2)
+
+
+def hash64_limbs_dynseed(xp, hi, lo, seed_hi, seed_lo):
+    """``hash64_limbs`` with the seed as (hi, lo) limb arrays/scalars.
+
+    Needed on device when the seed is a traced value (e.g. the simulation
+    tick inside a jitted step); matches ``hash64(x, seed)`` bit-for-bit.
+    """
+    shi, slo = splitmix64_limbs(xp, xp.asarray(seed_hi, xp.uint32),
+                                xp.asarray(seed_lo, xp.uint32))
+    return splitmix64_limbs(xp, hi.astype(xp.uint32) ^ shi,
+                            lo.astype(xp.uint32) ^ slo)
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from rapid_tpu.faults import HEALTHY, FaultModel
@@ -38,6 +39,42 @@ from rapid_tpu.types import (
 ReplyFn = Callable[[object], None]
 # A server handler receives (request, reply) and may call reply now or later.
 ServerHandler = Callable[[RapidRequest, ReplyFn], None]
+
+
+@dataclass
+class NetworkCounters:
+    """Message accounting, used by the engine differential for per-tick
+    message-count parity (``rapid_tpu.engine.diff``).
+
+    ``sent`` counts ``send()`` calls (probes take the synchronous fast path
+    and are tallied separately); ``delivered``/``dropped`` partition the
+    messages that came due; ``timeouts`` counts response callbacks fired
+    with None.
+    """
+
+    sent: int = 0
+    delivered: int = 0
+    dropped: int = 0
+    timeouts: int = 0
+    probes_sent: int = 0
+    probes_failed: int = 0
+
+    def snapshot(self) -> "NetworkCounters":
+        return NetworkCounters(**self.as_dict())
+
+    def delta(self, since: "NetworkCounters") -> "NetworkCounters":
+        return NetworkCounters(**{k: v - getattr(since, k)
+                                  for k, v in self.as_dict().items()})
+
+    def as_dict(self) -> Dict[str, int]:
+        return {
+            "sent": self.sent,
+            "delivered": self.delivered,
+            "dropped": self.dropped,
+            "timeouts": self.timeouts,
+            "probes_sent": self.probes_sent,
+            "probes_failed": self.probes_failed,
+        }
 
 
 class SimScheduler(IScheduler):
@@ -84,11 +121,17 @@ class SimNetwork:
         self._in_flight: Dict[int, List] = {}
         self._servers: Dict[Endpoint, "SimServer"] = {}
         self.rpc_timeout_ticks = 2
-        self.message_counter = 0  # observability: total messages sent
+        self.counters = NetworkCounters()       # cumulative
+        self.last_tick_counters = NetworkCounters()  # delta of the last step()
 
     @property
     def tick(self) -> int:
         return self.scheduler.now()
+
+    @property
+    def message_counter(self) -> int:
+        """Total messages sent (back-compat alias for ``counters.sent``)."""
+        return self.counters.sent
 
     # -- registration --------------------------------------------------------
 
@@ -107,7 +150,7 @@ class SimNetwork:
              on_response: Optional[ReplyFn] = None,
              timeout_ticks: Optional[int] = None) -> None:
         """Queue a message for delivery next tick."""
-        self.message_counter += 1
+        self.counters.sent += 1
         deliver_at = self.tick + 1
         self._in_flight.setdefault(deliver_at, []).append(
             (next(self._seq), src, dst, request, on_response)
@@ -121,6 +164,7 @@ class SimNetwork:
             def timeout(state=state, cb=on_response):
                 if not state["done"]:
                     state["done"] = True
+                    self.counters.timeouts += 1
                     cb(None)
             handle = self.scheduler.schedule(timeout_ticks + 1, timeout)
             # Replace the callback with a once-only wrapper that defuses the timeout.
@@ -143,12 +187,16 @@ class SimNetwork:
         it."""
         t = self.tick
         fm = self.fault_model
+        self.counters.probes_sent += 1
         if fm.is_crashed(subject, t) or fm.is_crashed(observer, t):
+            self.counters.probes_failed += 1
             return None
         if not fm.edge_ok(observer, subject, t):
+            self.counters.probes_failed += 1
             return None
         server = self._servers.get(subject)
         if server is None:
+            self.counters.probes_failed += 1
             return None
         if server.service is None:
             # Server up, protocol not ready (GrpcServer.java:83-95)
@@ -159,17 +207,22 @@ class SimNetwork:
 
     def step(self) -> None:
         """Advance one tick: deliver due messages, then run due tasks."""
+        before = self.counters.snapshot()
         t = self.tick + 1
         self.scheduler._advance(t)
         for seq, src, dst, request, reply in sorted(self._in_flight.pop(t, [])):
             fm = self.fault_model
             if fm.is_crashed(src, t):
+                self.counters.dropped += 1
                 continue  # sender died before the message got out
             if fm.is_crashed(dst, t) or not fm.edge_ok(src, dst, t):
+                self.counters.dropped += 1
                 continue  # lost; any reply timeout fires later
             server = self._servers.get(dst)
             if server is None:
+                self.counters.dropped += 1
                 continue
+            self.counters.delivered += 1
             if reply is not None:
                 # Route the reply back through the network (subject to faults).
                 def reply_via_net(resp, src=src, dst=dst, reply=reply):
@@ -178,6 +231,7 @@ class SimNetwork:
             else:
                 server.handle(request, lambda resp: None)
         self.scheduler._run_due(t)
+        self.last_tick_counters = self.counters.delta(before)
 
     def _deliver_reply(self, src: Endpoint, dst: Endpoint, resp: object,
                        reply: ReplyFn) -> None:

@@ -1,0 +1,80 @@
+"""1k-node oracle cluster-sim coverage (slow tier).
+
+View-level 1k-node tests exist (tests/test_membership_view.py), but until
+this file nothing exercised the *cluster simulation* — real SimNetwork,
+probe-based failure detectors, alert batching, consensus — at that scale.
+Bootstrapping 1k nodes through the sequential join protocol is O(N^3)
+messages, so the cluster is statically wired (the same shortcut the engine
+differential uses) and the join protocol itself is exercised by a small
+batch of real joiners on top.
+"""
+import pytest
+
+from rapid_tpu.engine.diff import (
+    boot_static_cluster,
+    default_endpoints,
+    default_node_ids,
+)
+from rapid_tpu.faults import CrashFault
+from rapid_tpu.oracle.cluster import Cluster
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint
+
+SETTINGS = Settings()
+N = 1000
+
+
+def verify_agreement(clusters, expected_size):
+    active = [c for c in clusters if c.is_active]
+    sizes = {c.get_membership_size() for c in active}
+    assert sizes == {expected_size}, f"sizes diverged: {sorted(sizes)[:5]}..."
+    configs = {c.get_configuration_id() for c in active}
+    assert len(configs) == 1, f"{len(configs)} distinct configuration ids"
+
+
+@pytest.mark.slow
+def test_thousand_node_cluster_sim_bootstrap():
+    crash = CrashFault()
+    endpoints = default_endpoints(N)
+    network, clusters, _ = boot_static_cluster(
+        SETTINGS, endpoints, default_node_ids(N), crash)
+    verify_agreement(clusters, N)
+
+    # Steady state: a converged 1k cluster stays quiescent (no protocol
+    # messages, only probes) across several FD intervals.
+    network.run_ticks(30)
+    assert network.counters.sent == 0
+    assert network.counters.probes_sent > 0
+    assert network.counters.probes_failed == 0
+    verify_agreement(clusters, N)
+
+    # Real join protocol on top of the statically-wired base.
+    joiners = [Cluster(network, Endpoint("joiner%d.sim" % i, 5000), SETTINGS)
+               for i in range(2)]
+    for j in joiners:
+        j.join(endpoints[0])
+    for _ in range(600):
+        if all(j.is_active for j in joiners) and \
+                clusters[0].get_membership_size() == N + 2:
+            break
+        network.step()
+    assert all(j.is_active for j in joiners), "1k-cluster joins timed out"
+    clusters.extend(joiners)
+    verify_agreement(clusters, N + 2)
+
+    # Crash burst: the probe FD detects, the cut converges, one view change
+    # removes all four.
+    victims = [endpoints[i] for i in (10, 400, 700, 999)]
+    t0 = network.tick
+    for v in victims:
+        crash.crashes[v] = t0 + 1
+    removed_size = N + 2 - len(victims)
+    for _ in range(160):
+        if clusters[0].get_membership_size() == removed_size:
+            break
+        network.step()
+    survivors = [c for c in clusters
+                 if c.listen_address not in set(victims)]
+    verify_agreement(survivors, removed_size)
+    memberlist = survivors[0].get_memberlist()
+    assert not any(v in memberlist for v in victims)

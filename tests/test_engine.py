@@ -1,0 +1,258 @@
+"""Engine kernel tests: topology parity, jitted smoke, vote counting.
+
+The heavyweight oracle-vs-engine differentials live in
+``tests/test_engine_diff.py``; these are the fast structural checks.
+"""
+import numpy as np
+import pytest
+
+from rapid_tpu import hashing
+from rapid_tpu.engine import (
+    build_topology,
+    engine_step,
+    init_state,
+    simulate,
+    state_config_id,
+    trace_count,
+)
+from rapid_tpu.engine.state import I32_MAX, crash_faults
+from rapid_tpu.oracle.membership_view import MembershipView, uid_of
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, NodeId
+
+SETTINGS = Settings()
+
+
+def make_members(n):
+    endpoints = [Endpoint(f"n{i}.sim", 5000) for i in range(n)]
+    node_ids = [NodeId(i + 1, (i + 1) * 7919) for i in range(n)]
+    view = MembershipView(SETTINGS.K, node_ids, endpoints)
+    return endpoints, node_ids, view
+
+
+def boot_engine(n, start_tick=0):
+    endpoints, _, view = make_members(n)
+    uids = [uid_of(e) for e in endpoints]
+    return endpoints, view, init_state(uids, view._id_fp_sum, SETTINGS,
+                                       start_tick=start_tick)
+
+
+# ---------------------------------------------------------------------------
+# topology kernel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [2, 5, 64])
+def test_topology_matches_oracle(n):
+    import jax.numpy as jnp
+
+    endpoints, _, view = make_members(n)
+    uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
+    uid_hi, uid_lo = hashing.np_to_limbs(uids)
+    member = jnp.ones((n,), bool)
+    subj_idx, obs_idx, fd_active, _ = build_topology(
+        jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
+    subj_idx = np.asarray(subj_idx)
+    obs_idx = np.asarray(obs_idx)
+    fd_active = np.asarray(fd_active)
+
+    slot_of = {e: i for i, e in enumerate(endpoints)}
+    for i, e in enumerate(endpoints):
+        oracle_subj = [slot_of[s] for s in view.get_subjects_of(e)]
+        oracle_obs = [slot_of[o] for o in view.get_observers_of(e)]
+        assert list(subj_idx[i]) == oracle_subj
+        assert list(obs_idx[i]) == oracle_obs
+        # one failure detector per *unique* subject, first ring wins
+        seen = set()
+        expect_active = []
+        for s in oracle_subj:
+            expect_active.append(s not in seen)
+            seen.add(s)
+        assert list(fd_active[i]) == expect_active
+
+
+def test_topology_nonmember_rows_masked():
+    import jax.numpy as jnp
+
+    endpoints, _, _ = make_members(8)
+    uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
+    uid_hi, uid_lo = hashing.np_to_limbs(uids)
+    member = jnp.asarray([True] * 6 + [False] * 2)
+    subj_idx, obs_idx, fd_active, _ = build_topology(
+        jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
+    assert np.all(np.asarray(subj_idx)[6:] == np.arange(6, 8)[:, None])
+    assert np.all(np.asarray(obs_idx)[6:] == np.arange(6, 8)[:, None])
+    assert not np.asarray(fd_active)[6:].any()
+    # member rows never point at a non-member
+    assert np.asarray(subj_idx)[:6].max() < 6
+    assert np.asarray(obs_idx)[:6].max() < 6
+
+
+# ---------------------------------------------------------------------------
+# consensus kernel
+# ---------------------------------------------------------------------------
+
+
+def test_segmented_vote_count_matches_bincount():
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine.votes import count_fast_round, segmented_vote_count
+
+    rng = np.random.default_rng(7)
+    c = 65
+    values = rng.integers(0, 4, size=c)  # 4 distinct proposals
+    vote_hi = jnp.asarray(values.astype(np.uint32))
+    vote_lo = jnp.asarray((values * 977).astype(np.uint32))
+    valid = jnp.asarray(rng.random(c) < 0.8)
+
+    counts = np.asarray(segmented_vote_count(jnp, vote_hi, vote_lo, valid))
+    valid_np = np.asarray(valid)
+    for i in range(c):
+        expect = int(np.sum(valid_np & (values == values[i]))) \
+            if valid_np[i] else 0
+        assert counts[i] == expect
+
+    n_member = jnp.int32(c)
+    decided, winner = count_fast_round(jnp, vote_hi, vote_lo, valid, n_member)
+    quorum = c - (c - 1) // 4
+    best = max(int(np.sum(valid_np & (values == v))) for v in range(4))
+    assert int(winner) == best
+    assert bool(decided) == (int(valid_np.sum()) >= quorum and best >= quorum)
+
+
+def test_fast_quorum_formula():
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine.votes import fast_quorum
+
+    for n, expect in [(1, 1), (4, 4), (5, 4), (16, 13), (100, 76)]:
+        assert int(fast_quorum(jnp, jnp.int32(n))) == expect
+
+
+# ---------------------------------------------------------------------------
+# jitted step smoke (tier-1 acceptance: one step = one jitted call)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_step_smoke_n64_single_trace():
+    from dataclasses import replace
+
+    # A distinct (but behaviorally identical) Settings instance guarantees a
+    # fresh jit cache entry, so the trace count below is deterministic even
+    # if other tests already compiled the step at this shape.
+    settings = replace(SETTINGS, seed=1234)
+    endpoints, _, view = make_members(64)
+    uids = [uid_of(e) for e in endpoints]
+    state = init_state(uids, view._id_fp_sum, settings)
+    faults = crash_faults([I32_MAX] * 64)
+
+    before = trace_count()
+    state1, log1 = engine_step(state, faults, settings)
+    first_trace = trace_count() - before
+    assert first_trace == 1, "first call should trace the step body once"
+    assert int(state1.tick) == 1
+    assert int(log1.n_member) == 64
+
+    # further calls reuse the compiled step: the traced body never reruns
+    state2, _ = engine_step(state1, faults, settings)
+    state3, _ = engine_step(state2, faults, settings)
+    assert trace_count() - before == 1
+    assert int(state3.tick) == 3
+    assert state_config_id(state3) == view.get_current_configuration_id()
+
+
+def test_simulate_scan_matches_stepwise():
+    _, _, state = boot_engine(16)
+    crash = [I32_MAX] * 16
+    crash[2] = 3
+    faults = crash_faults(crash)
+
+    final_scan, logs = simulate(state, faults, 25, SETTINGS)
+    s = state
+    for _ in range(25):
+        s, _ = engine_step(s, faults, SETTINGS)
+    assert int(final_scan.tick) == int(s.tick) == 25
+    assert np.array_equal(np.asarray(final_scan.fc), np.asarray(s.fc))
+    assert np.array_equal(np.asarray(final_scan.member),
+                          np.asarray(s.member))
+    assert np.asarray(logs.tick).tolist() == list(range(1, 26))
+
+
+def test_engine_detects_and_removes_crash_burst():
+    """End-to-end engine-only: a crash burst yields one view change with
+    the oracle-predicted timing (notify t1+100, decide t1+103)."""
+    _, view, state = boot_engine(32)
+    crash = [I32_MAX] * 32
+    for s in (4, 9):
+        crash[s] = 5
+    faults = crash_faults(crash)
+    final, logs = simulate(state, faults, 130, SETTINGS)
+
+    ann = np.asarray(logs.announce_now)
+    dec = np.asarray(logs.decide_now)
+    ticks = np.asarray(logs.tick)
+    assert ticks[ann].tolist() == [112]
+    assert ticks[dec].tolist() == [113]
+    i = int(np.argmax(dec))
+    assert np.nonzero(np.asarray(logs.decision[i]))[0].tolist() == [4, 9]
+    assert int(np.asarray(logs.n_member)[i]) == 30
+    # config id after the removal matches the oracle view algebra
+    view.ring_delete(Endpoint("n4.sim", 5000))
+    view.ring_delete(Endpoint("n9.sim", 5000))
+    assert state_config_id(final) == view.get_current_configuration_id()
+
+
+def test_bench_engine_emits_json_with_trailing_newline(capsys):
+    import importlib.util
+    import json
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "benchmarks" \
+        / "bench_engine.py"
+    spec = importlib.util.spec_from_file_location("bench_engine", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--n", "64", "--ticks", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.endswith("\n"), "BENCH JSON must end with a newline"
+    payload = json.loads(out)
+    assert payload["bench"] == "engine_tick"
+    assert payload["n"] == 64
+    assert payload["ticks_per_sec"] > 0
+    assert payload["final_members"] == 64
+
+
+# ---------------------------------------------------------------------------
+# 64-bit limb helpers added for the engine
+# ---------------------------------------------------------------------------
+
+
+def test_limb_sub_and_sum():
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 1 << 64, size=33, dtype=np.uint64)
+    hi, lo = hashing.np_to_limbs(vals)
+    shi, slo = hashing.sum64(np, hi, lo)
+    expect = int(vals.sum(dtype=np.uint64))
+    assert hashing.from_limbs(int(shi), int(slo)) == expect
+
+    a, b = int(vals[0]), int(vals[1])
+    ahi, alo = hashing.to_limbs(a)
+    bhi, blo = hashing.to_limbs(b)
+    with np.errstate(over="ignore"):  # mod-2^32 wraparound is the semantics
+        dhi, dlo = hashing.sub64(np, np.uint32(ahi), np.uint32(alo),
+                                 np.uint32(bhi), np.uint32(blo))
+    assert hashing.from_limbs(int(dhi), int(dlo)) == (a - b) % (1 << 64)
+
+
+def test_hash64_limbs_dynseed_matches_static():
+    rng = np.random.default_rng(13)
+    vals = rng.integers(0, 1 << 64, size=16, dtype=np.uint64)
+    hi, lo = hashing.np_to_limbs(vals)
+    for seed in (0, 1, 12345):
+        ehi, elo = hashing.hash64_limbs(np, hi, lo, seed=seed)
+        shi, slo = hashing.to_limbs(seed)
+        with np.errstate(over="ignore"):  # mod-2^32 wraparound semantics
+            dhi, dlo = hashing.hash64_limbs_dynseed(
+                np, hi, lo, np.uint32(shi), np.uint32(slo))
+        assert np.array_equal(ehi, dhi) and np.array_equal(elo, dlo)

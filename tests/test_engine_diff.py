@@ -1,0 +1,63 @@
+"""Oracle-vs-engine differentials: bit-identical cut decisions.
+
+Each scenario replays through the python oracle and the jax engine and
+asserts identical proposal emission ticks and contents, view-change ticks
+and contents, 64-bit configuration ids, and per-tick message counts
+(``DiffResult.assert_identical``). Scenarios respect the crash-burst
+envelope documented in ``rapid_tpu.engine.diff``: all crashes in a burst
+share their first failing FD tick.
+"""
+import pytest
+
+from rapid_tpu.engine.diff import run_differential
+
+
+def test_differential_n64_single_crash():
+    res = run_differential(64, {7: 5}, 130)
+    res.assert_identical()
+    kinds = [(e.kind, e.tick, e.slots) for e in res.engine_events]
+    assert kinds == [("proposal", 112, (7,)), ("view_change", 113, (7,))]
+
+
+def test_differential_n64_crash_burst():
+    res = run_differential(64, {3: 5, 17: 5, 40: 7}, 130)
+    res.assert_identical()
+    assert [e.slots for e in res.engine_events] == [(3, 17, 40)] * 2
+
+
+def test_differential_n64_two_sequential_bursts():
+    # Second burst crashes at 201/205: both first fail at FD tick 210
+    # (same cohort), long after the first removal completes at 113.
+    res = run_differential(64, {3: 5, 17: 5, 40: 201, 41: 205}, 360)
+    res.assert_identical()
+    assert [(e.kind, e.tick) for e in res.engine_events] == [
+        ("proposal", 112), ("view_change", 113),
+        ("proposal", 312), ("view_change", 313),
+    ]
+    assert res.engine_events[2].slots == (40, 41)
+
+
+def test_differential_n64_no_faults_quiescent():
+    res = run_differential(64, {}, 60)
+    res.assert_identical()
+    assert res.engine_events == []
+    # a healthy cluster sends no messages at all (probes are counted apart)
+    assert all(c["sent"] == 0 for c in res.engine_counters)
+    assert any(c["probes_sent"] > 0 for c in res.engine_counters)
+
+
+def test_differential_n256_crash_burst():
+    res = run_differential(256, {5: 11, 100: 13, 200: 15, 250: 19}, 140)
+    res.assert_identical()
+    assert [(e.kind, e.tick, e.slots) for e in res.engine_events] == [
+        ("proposal", 122, (5, 100, 200, 250)),
+        ("view_change", 123, (5, 100, 200, 250)),
+    ]
+
+
+@pytest.mark.slow
+def test_differential_n256_large_burst():
+    res = run_differential(256, {s: 5 for s in range(0, 64, 2)}, 140)
+    res.assert_identical()
+    assert len(res.engine_events) == 2
+    assert res.engine_events[1].slots == tuple(range(0, 64, 2))

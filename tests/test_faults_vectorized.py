@@ -1,0 +1,137 @@
+"""Equivalence of the array-native fault-mask overrides vs the scalar path.
+
+The engine materializes fault models as mask tensors; these tests pin the
+vectorized ``crash_mask``/``edge_mask`` overrides to the per-edge
+``is_crashed``/``edge_ok`` oracle semantics at small N, where the generic
+O(n^2) loop is still affordable.
+"""
+import numpy as np
+import pytest
+
+from rapid_tpu.faults import (
+    HEALTHY,
+    ComposedFault,
+    CrashFault,
+    FaultModel,
+    FlipFlopFault,
+    OneWayPartitionFault,
+    PacketDropFault,
+)
+from rapid_tpu.types import Endpoint
+
+N = 24
+ENDPOINTS = [Endpoint(f"f{i}.sim", 7000) for i in range(N)]
+TICKS = [0, 1, 7, 10, 199, 200, 205, 399, 400, 1000]
+
+
+def scalar_edge_mask(model, endpoints, tick):
+    """The base-class loop, inlined so overrides can't shadow it."""
+    n = len(endpoints)
+    mask = np.ones((n, n), dtype=bool)
+    for i, s in enumerate(endpoints):
+        for j, d in enumerate(endpoints):
+            mask[i, j] = model.edge_ok(s, d, tick)
+    return mask
+
+
+def scalar_crash_mask(model, endpoints, tick):
+    return np.array([model.is_crashed(e, tick) for e in endpoints],
+                    dtype=bool)
+
+
+def models():
+    third = frozenset(ENDPOINTS[::3])
+    return [
+        HEALTHY,
+        CrashFault({ENDPOINTS[2]: 5, ENDPOINTS[9]: 200}),
+        PacketDropFault(p=0.5, seed=3),
+        PacketDropFault(p=0.8, targets=third, ingress=True, egress=False,
+                        seed=11),
+        PacketDropFault(p=0.3, targets=third, ingress=False, egress=True,
+                        seed=12),
+        OneWayPartitionFault(from_set=frozenset(ENDPOINTS[:8]),
+                             to_set=third, start_tick=10, end_tick=400),
+        FlipFlopFault(targets=third, period_ticks=200, start_tick=5),
+        FlipFlopFault(targets=third, period_ticks=100, one_way=False),
+        ComposedFault([
+            CrashFault({ENDPOINTS[0]: 7}),
+            OneWayPartitionFault(from_set=third,
+                                 to_set=frozenset(ENDPOINTS[1:2])),
+            PacketDropFault(p=0.2, seed=5),
+        ]),
+    ]
+
+
+@pytest.mark.parametrize("model", models(), ids=lambda m: type(m).__name__)
+def test_edge_mask_matches_scalar_path(model):
+    for tick in TICKS:
+        vec = model.edge_mask(ENDPOINTS, tick)
+        ref = scalar_edge_mask(model, ENDPOINTS, tick)
+        assert vec.shape == (N, N) and vec.dtype == np.bool_
+        assert np.array_equal(vec, ref), \
+            f"{type(model).__name__} diverged at tick {tick}"
+
+
+@pytest.mark.parametrize("model", models(), ids=lambda m: type(m).__name__)
+def test_crash_mask_matches_scalar_path(model):
+    for tick in TICKS:
+        vec = model.crash_mask(ENDPOINTS, tick)
+        ref = scalar_crash_mask(model, ENDPOINTS, tick)
+        assert np.array_equal(vec, ref)
+
+
+def test_base_class_shortcut_requires_no_edge_ok_calls():
+    """The healthy fast path must not invoke edge_ok at all."""
+
+    class Counting(FaultModel):
+        calls = 0
+
+    model = Counting()
+    orig = FaultModel.edge_ok
+
+    def counting_edge_ok(self, src, dst, tick):
+        Counting.calls += 1
+        return orig(self, src, dst, tick)
+
+    # The shortcut keys off ``type(self).edge_ok is FaultModel.edge_ok``;
+    # a subclass that *does* override must still go through the loop.
+    class Overriding(FaultModel):
+        def edge_ok(self, src, dst, tick):
+            Overriding.calls += 1
+            return True
+
+    Overriding.calls = 0
+    mask = model.edge_mask(ENDPOINTS, 0)
+    assert mask.all() and Counting.calls == 0
+
+    o = Overriding()
+    mask = o.edge_mask(ENDPOINTS, 0)
+    assert mask.all() and Overriding.calls == N * N
+
+
+def test_engine_edge_drop_matches_host_bernoulli():
+    """The engine's in-jit drop sampler bit-matches faults._bernoulli."""
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine.monitor import edge_drop
+    from rapid_tpu.engine.state import EngineFaults
+    from rapid_tpu.faults import _bernoulli
+    from rapid_tpu.hashing import np_to_limbs
+    from rapid_tpu.oracle.membership_view import uid_of
+
+    uids = np.array([uid_of(e) for e in ENDPOINTS], dtype=np.uint64)
+    uid_hi, uid_lo = np_to_limbs(uids)
+    src = np.arange(N, dtype=np.int32)
+    dst = np.roll(src, 7)
+    for tick in (0, 3, 250):
+        for p, seed in ((0.5, 3), (0.9, 44)):
+            faults = EngineFaults(
+                crash_tick=jnp.full((N,), 1 << 30, jnp.int32),
+                drop_p=p, drop_seed=seed)
+            got = np.asarray(edge_drop(
+                jnp, faults, jnp.asarray(src), jnp.asarray(dst),
+                jnp.asarray(uid_hi), jnp.asarray(uid_lo), jnp.int32(tick)))
+            expect = np.array([
+                _bernoulli(seed, int(uids[s]), int(uids[d]), tick, p)
+                for s, d in zip(src, dst)])
+            assert np.array_equal(got, expect)

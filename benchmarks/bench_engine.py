@@ -1,16 +1,24 @@
 """Benchmark the jitted tick engine: simulated-gossip-rounds/sec.
 
-Runs an N-node crash-burst scenario through ``rapid_tpu.engine.simulate``
-(one jit-compiled ``lax.scan`` dispatch for the whole run) and reports
-throughput. One *gossip round* is one failure-detector interval — the
-period in which every node probes each unique subject once — i.e.
+Two scenarios, selected with ``--scenario``:
+
+- ``steady`` (default): an N-node crash-burst through
+  ``rapid_tpu.engine.simulate`` — one jit-compiled ``lax.scan`` dispatch
+  for the whole run.
+- ``churn``: sustained membership churn via
+  ``rapid_tpu.engine.churn.synthetic_churn_schedule`` — alternating
+  join/leave bursts reconfigure the view inside the same scan.
+
+One *gossip round* is one failure-detector interval — the period in
+which every node probes each unique subject once — i.e.
 ``fd_interval_ticks`` simulated ticks.
 
 The BASELINE.json metric is rounds/sec at N=100k:
 
     JAX_PLATFORMS=cpu python benchmarks/bench_engine.py --n 100000
 
-Emits one BENCH-style JSON object (with trailing newline) on stdout.
+Emits one BENCH-style JSON object (with trailing newline) on stdout, or
+to ``--out FILE`` when given.
 """
 from __future__ import annotations
 
@@ -27,23 +35,23 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 import numpy as np  # noqa: E402
 
 
-def synthetic_uids(n: int) -> np.ndarray:
+def synthetic_uids(n: int, seed: int = 0) -> np.ndarray:
     """Distinct 64-bit node identities without hashing n hostnames."""
     from rapid_tpu import hashing
 
     hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
-    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF)
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF ^ (seed & 0xFFFF))
     return hashing.np_from_limbs(hi, lo)
 
 
 def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
-        settings) -> dict:
+        settings, seed: int = 0) -> dict:
     import jax
 
     from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
     from rapid_tpu.engine.step import simulate
 
-    uids = synthetic_uids(n)
+    uids = synthetic_uids(n, seed)
     boot_start = time.perf_counter()
     state = init_state(uids, id_fp_sum=0, settings=settings)
     jax.block_until_ready(state)
@@ -87,6 +95,67 @@ def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
     }
 
 
+def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0) -> dict:
+    """Sustained join/leave churn: membership oscillates between ``n`` and
+    ``n + burst`` while the jitted scan reconfigures the view on every
+    decided proposal."""
+    import jax
+
+    from rapid_tpu.engine.churn import synthetic_churn_schedule
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.step import simulate
+
+    period = settings.churn_decide_delay_ticks + 3
+    start = 10
+    cycles = max(1, (ticks - start) // (2 * period))
+    capacity = n + cycles * burst
+    uids = synthetic_uids(capacity, seed)
+    member = np.zeros(capacity, bool)
+    member[:n] = True
+
+    schedule, id_fps, info = synthetic_churn_schedule(
+        capacity, n, settings, start=start, burst=burst, period=period)
+
+    boot_start = time.perf_counter()
+    state = init_state(uids, id_fp_sum=0, settings=settings,
+                       member=member, id_fps=id_fps)
+    jax.block_until_ready(state)
+    boot_s = time.perf_counter() - boot_start
+
+    faults = crash_faults([I32_MAX] * capacity)
+
+    compile_start = time.perf_counter()
+    final, logs = simulate(state, faults, ticks, settings, churn=schedule)
+    jax.block_until_ready((final, logs))
+    compile_s = time.perf_counter() - compile_start
+
+    run_start = time.perf_counter()
+    final, logs = simulate(state, faults, ticks, settings, churn=schedule)
+    jax.block_until_ready((final, logs))
+    wall_s = time.perf_counter() - run_start
+
+    decisions = int(np.asarray(logs.decide_now).sum())
+    ticks_per_sec = ticks / wall_s
+    return {
+        "bench": "engine_tick",
+        "scenario": "churn",
+        "platform": jax.default_backend(),
+        "n": n,
+        "capacity": capacity,
+        "k": settings.K,
+        "ticks": ticks,
+        "churn_bursts": info["bursts"],
+        "burst_size": info["burst_size"],
+        "boot_s": round(boot_s, 4),
+        "compile_s": round(compile_s, 4),
+        "wall_s": round(wall_s, 4),
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "rounds_per_sec": round(ticks_per_sec / settings.fd_interval_ticks, 2),
+        "decisions": decisions,
+        "final_members": int(np.asarray(final.member).sum()),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--n", type=int, default=10_000,
@@ -98,6 +167,17 @@ def main(argv=None) -> int:
                         help="fraction of nodes crashing (default 1%%)")
     parser.add_argument("--crash-tick", type=int, default=5,
                         help="tick of the correlated crash burst")
+    parser.add_argument("--scenario", choices=("steady", "churn"),
+                        default="steady",
+                        help="steady crash-burst or sustained join/leave "
+                             "churn (default steady)")
+    parser.add_argument("--burst", type=int, default=8,
+                        help="churn scenario: slots per join/leave burst")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="perturbs the synthetic node identities")
+    parser.add_argument("--out", type=str, default=None,
+                        help="write the JSON artifact to FILE (default: "
+                             "stdout)")
     parser.add_argument("--sweep", action="store_true",
                         help="run the BASELINE sweep n in {1k, 10k, 100k}")
     args = parser.parse_args(argv)
@@ -106,12 +186,22 @@ def main(argv=None) -> int:
 
     settings = Settings(K=args.k)
     sizes = [1_000, 10_000, 100_000] if args.sweep else [args.n]
-    results = [run(n, args.ticks, args.crash_frac, args.crash_tick, settings)
-               for n in sizes]
+    if args.scenario == "churn":
+        results = [run_churn(n, args.ticks, args.burst, settings, args.seed)
+                   for n in sizes]
+    else:
+        results = [run(n, args.ticks, args.crash_frac, args.crash_tick,
+                       settings, args.seed)
+                   for n in sizes]
     payload = results[0] if len(results) == 1 else {"bench": "engine_tick",
                                                     "sweep": results}
     # BENCH artifacts end with a newline (ADVICE.md round-5 nit).
-    sys.stdout.write(json.dumps(payload, indent=2) + "\n")
+    text = json.dumps(payload, indent=2) + "\n"
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
     return 0
 
 

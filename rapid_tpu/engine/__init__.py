@@ -4,9 +4,20 @@ The host oracle (``rapid_tpu.oracle``) runs the protocol one event at a
 time; the engine runs the same steady-state pipeline — K-ring probe
 monitoring, multi-node cut detection, Fast Paxos fast-round vote counting —
 as one jit-compiled step over ``[capacity]``-shaped tensors, scanned with
-``lax.scan``. ``rapid_tpu.engine.diff`` replays crash-fault scenarios
-through both and asserts bit-identical cut decisions.
+``lax.scan``. Dynamic membership rides the same step: ``rapid_tpu.engine
+.churn`` compiles join/leave scenarios into a ``ChurnSchedule`` of
+per-slot alert enqueue ticks, and a decided proposal reconfigures the
+view inside the scan. ``rapid_tpu.engine.diff`` replays crash and churn
+scenarios through both sides and asserts bit-identical cut decisions.
 """
+from rapid_tpu.engine.churn import (
+    ChurnEnvelopeError,
+    ChurnPlan,
+    ChurnSchedule,
+    empty_schedule,
+    plan_churn,
+    synthetic_churn_schedule,
+)
 from rapid_tpu.engine.state import (
     EngineFaults,
     EngineState,
@@ -18,14 +29,20 @@ from rapid_tpu.engine.step import engine_step, simulate, step, trace_count
 from rapid_tpu.engine.topology import build_topology
 
 __all__ = [
+    "ChurnEnvelopeError",
+    "ChurnPlan",
+    "ChurnSchedule",
     "EngineFaults",
     "EngineState",
     "StepLog",
     "build_topology",
+    "empty_schedule",
     "engine_step",
     "init_state",
+    "plan_churn",
     "simulate",
     "state_config_id",
     "step",
+    "synthetic_churn_schedule",
     "trace_count",
 ]

@@ -1,0 +1,505 @@
+"""Dynamic-membership churn: batched join/leave lifecycle for the engine.
+
+The engine keeps the slot universe fixed (``capacity >= N``): joiners are
+pre-allocated *dormant* slots whose ``member`` flag flips when a decided
+join proposal lands, leavers stay allocated but drop out of the member
+mask. What moves between host and device:
+
+- **Device** (``ChurnSchedule``, consumed by ``engine.step`` phase 4a):
+  per-slot enqueue ticks for join-UP and leave-DOWN alert bursts, each
+  guarded by the configuration epoch expected at enqueue time. From the
+  enqueue on, the alert rides the same batched pipeline as monitor DOWNs
+  (flush after one quiescent batching window, deliver one hop later,
+  aggregate, announce, fast-round vote, decide) and a decided proposal
+  triggers the full view reconfiguration *inside* the jitted scan:
+  membership XOR, fingerprint-sum updates, per-ring topology rebuild,
+  detector/cut/consensus reset scoped by the epoch bump.
+
+- **Host** (``plan_churn``): everything the oracle does with *messages
+  that are not alert broadcasts* — the two-phase join gatekeeping
+  (PreJoin at the seed, JoinMessages at the K gatekeepers), NodeId
+  retries on UUID collisions, graceful-leave LeaveMessage fan-out, and
+  the failure detectors' notify bookkeeping. The planner replays that
+  protocol against a host-side ``MembershipView`` mirror and compiles it
+  down to the enqueue ticks above, raising ``ChurnEnvelopeError``
+  whenever the scenario leaves the envelope in which the batched engine
+  is bit-identical to the oracle.
+
+The churn envelope (checked per scenario, not assumed):
+
+- one alert pipeline in flight at a time: join/leave alerts enqueued
+  while a proposal is announcing/deciding would be dropped by the
+  oracle's config-id filter but re-driven by its join retry logic, which
+  the single-shot schedule does not model (crash notifications in the
+  same window are dropped *consistently* on both sides and merely
+  re-notify after the decide, so they stay in the envelope);
+- the view must not change between a join's phase-1 evaluation and its
+  alert enqueue (the oracle would answer CONFIG_CHANGED and retry);
+- every burst must produce exactly one proposal emission containing all
+  its destinations. The oracle's ``MultiNodeCutDetector`` emits at the
+  instant a destination crosses H with zero destinations in flux — a
+  same-tick burst where one destination is stuck below L while another
+  crosses H emits a *partial* proposal. The planner replays the exact
+  sequential per-batch aggregation (real ``MultiNodeCutDetector``,
+  batches in service-creation order) and rejects partial emissions;
+- joins must decide before their ``join_timeout_ticks`` retry fires (a
+  heap tie goes to the timeout task: its handle predates the response),
+  the seed must stay an alive member through phase 1, leavers must
+  outlive their LeaveMessage hop, joiners their wiring response hop;
+- a decide at tick D with ``(D+1) % fd_interval_ticks == 0`` under
+  crash faults is rejected: the freshly wired joiner's failure
+  detectors first fire at ``D+1+I`` in the oracle but the engine's
+  uniform ``fd_gate`` would probe its row at ``D+1``.
+
+``plan_churn`` returns the device schedule *and* the predicted event
+stream (proposals/view changes with ticks, slots and 64-bit config ids),
+so the differential harness (``engine.diff.run_churn_differential``) can
+triangulate oracle vs engine vs plan.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rapid_tpu import hashing
+from rapid_tpu.engine.state import I32_MAX
+from rapid_tpu.oracle.cluster import default_rng
+from rapid_tpu.oracle.cut_detector import MultiNodeCutDetector
+from rapid_tpu.oracle.membership_view import MembershipView, id_fingerprint
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (AlertMessage, EdgeStatus, Endpoint,
+                             JoinStatusCode, NodeId)
+
+
+class ChurnEnvelopeError(ValueError):
+    """The scenario leaves the envelope where the batched engine is
+    bit-identical to the oracle (see module docstring)."""
+
+
+class ChurnSchedule(NamedTuple):
+    """Device-side churn schedule: per-slot alert enqueue ticks.
+
+    ``I32_MAX`` means never. ``*_epoch`` is the configuration epoch the
+    planner expects at the enqueue tick; the engine injects the alert
+    only while the expectation holds, mirroring the oracle's config-id
+    filter expiring stale alerts. A NamedTuple of arrays is a jax pytree,
+    so the schedule threads through ``jit``/``lax.scan`` untouched.
+    """
+
+    join_tick: np.ndarray    # int32 [C]
+    join_epoch: np.ndarray   # int32 [C]
+    leave_tick: np.ndarray   # int32 [C]
+    leave_epoch: np.ndarray  # int32 [C]
+
+
+def empty_schedule(c: int) -> ChurnSchedule:
+    return ChurnSchedule(
+        join_tick=np.full(c, I32_MAX, np.int32),
+        join_epoch=np.zeros(c, np.int32),
+        leave_tick=np.full(c, I32_MAX, np.int32),
+        leave_epoch=np.zeros(c, np.int32),
+    )
+
+
+@dataclass
+class ChurnPlan:
+    """Output of ``plan_churn``: the compiled schedule plus the planner's
+    own prediction of the protocol-visible event stream."""
+
+    schedule: ChurnSchedule
+    id_fps: np.ndarray                   # uint64 [C] identifier fingerprints
+    joiner_ids: Dict[int, NodeId]        # slot -> decided NodeId
+    wired: Dict[int, int]                # slot -> tick the joiner's service starts
+    events: List[Tuple[int, str, int, Tuple[int, ...]]]
+    final_members: frozenset
+    final_config_id: int
+
+
+def plan_churn(
+    endpoints: Sequence[Endpoint],
+    initial_n: int,
+    node_ids: Sequence[NodeId],
+    n_ticks: int,
+    settings: Settings,
+    joins: Optional[Dict[int, int]] = None,
+    leaves: Optional[Dict[int, int]] = None,
+    crashes: Optional[Dict[int, int]] = None,
+    seed_slot: int = 0,
+) -> ChurnPlan:
+    """Compile a churn scenario into a device schedule.
+
+    ``endpoints`` is the full slot universe (initial members first, then
+    dormant joiner slots), ``joins``/``leaves`` map slot -> the tick the
+    host calls ``Cluster.join(seed)`` / ``leave_gracefully()``, and
+    ``crashes`` maps slot -> crash tick (the same fault model handed to
+    the engine). The planner advances a host-side mirror of the oracle
+    tick by tick — view, failure-detector counters, the single alert
+    pipeline — and raises ``ChurnEnvelopeError`` the moment the scenario
+    exits the bit-identical envelope.
+    """
+    joins = dict(joins or {})
+    leaves = dict(leaves or {})
+    crashes = dict(crashes or {})
+    c = len(endpoints)
+    if not (0 < initial_n <= c):
+        raise ValueError(f"initial_n {initial_n} out of range for C={c}")
+    if len(node_ids) < initial_n:
+        raise ValueError("need a NodeId per initial member")
+    for s, t0 in joins.items():
+        if not (initial_n <= s < c):
+            raise ChurnEnvelopeError(
+                f"join slot {s} is not a dormant slot (initial membership "
+                f"owns [0, {initial_n}))")
+        if t0 < 1:
+            raise ValueError(f"join tick {t0} for slot {s} must be >= 1")
+    for s, t0 in leaves.items():
+        if not (0 <= s < c):
+            raise ValueError(f"leave slot {s} out of range")
+        if t0 < 1:
+            raise ValueError(f"leave tick {t0} for slot {s} must be >= 1")
+
+    view = MembershipView(settings.K, list(node_ids[:initial_n]),
+                          list(endpoints[:initial_n]))
+    slot_of = {e: i for i, e in enumerate(endpoints)}
+    members = set(range(initial_n))
+    creation_order = list(range(initial_n))
+    epoch = 0
+    fd_gate = 0
+    fd_cnt: Dict[int, int] = {}
+    fd_notified: set = set()
+    pending: Optional[dict] = None
+    leave_epochs: Dict[int, int] = {}
+    events: List[Tuple[int, str, int, Tuple[int, ...]]] = []
+    wired: Dict[int, int] = {}
+    interval = settings.fd_interval_ticks
+
+    def alive(s: int, t: int) -> bool:
+        ct = crashes.get(s)
+        return ct is None or t < ct
+
+    # Joiner state machines. The NodeId sequence replicates the oracle's
+    # Cluster rng exactly (same seed formula, same draw order: one 128-bit
+    # id per attempt, drawn before the service ever touches the rng).
+    js: Dict[int, dict] = {}
+    for s, t0 in joins.items():
+        rng = default_rng(settings, endpoints[s])
+        js[s] = {
+            "attempt": 1, "start": t0,
+            "node_id": NodeId(rng.getrandbits(64), rng.getrandbits(64)),
+            "rng": rng, "p1_epoch": None, "enq": None, "done": False,
+        }
+
+    def announce_sim(dsts: Dict[int, str], t_ann: int) -> Optional[set]:
+        """Replay the oracle's sequential per-batch cut aggregation at the
+        delivery tick; returns the first emitted proposal as a slot set,
+        or None if the burst never emits."""
+        det = MultiNodeCutDetector(settings.K, settings.H, settings.L)
+        batches: Dict[int, list] = {}
+        for d, kind in dsts.items():
+            ep = endpoints[d]
+            if kind == "join":
+                srcs = view.get_expected_observers_of(ep)
+                status = EdgeStatus.UP
+            else:
+                srcs = view.get_observers_of(ep)
+                status = EdgeStatus.DOWN
+            per_src: Dict[Endpoint, List[int]] = {}
+            for ring, src_ep in enumerate(srcs):
+                per_src.setdefault(src_ep, []).append(ring)
+            for src_ep, rings in per_src.items():
+                src = slot_of[src_ep]
+                if not alive(src, t_ann):
+                    continue  # batch dropped at delivery, sender crashed
+                batches.setdefault(src, []).append((kind, d, AlertMessage(
+                    edge_src=src_ep, edge_dst=ep, edge_status=status,
+                    configuration_id=0, ring_numbers=tuple(rings))))
+        # Batches arrive in the senders' service-creation order (the
+        # scheduler-handle order of their periodic batcher jobs); within a
+        # batch, leave/join alerts (message deliveries, in sender-op
+        # order = destination slot order under the harness's sorted
+        # scheduling) precede crash notifications (run-due FD tasks,
+        # which fire in the source's failure-detector creation order:
+        # its subjects deduplicated in ring order).
+        kind_rank = {"leave": 0, "join": 1, "crash": 2}
+
+        def alert_order(src_ep: Endpoint):
+            fd_order = {e: i for i, e in enumerate(
+                dict.fromkeys(view.get_subjects_of(src_ep)))}
+
+            def key(a):
+                kind, d, _ = a
+                return (kind_rank[kind],
+                        fd_order.get(endpoints[d], d) if kind == "crash"
+                        else d)
+            return key
+
+        for src in (s for s in creation_order if s in batches):
+            prop: Dict[Endpoint, None] = {}
+            for _, _, alert in sorted(
+                    batches[src], key=alert_order(endpoints[src])):
+                for node in det.aggregate_for_proposal(alert):
+                    prop[node] = None
+            for node in det.invalidate_failing_edges(view):
+                prop[node] = None
+            if prop:
+                return {slot_of[e] for e in prop}
+        return None
+
+    schedule = empty_schedule(c)
+
+    for t in range(1, n_ticks + 1):
+        # -- A: fast-round votes arrive; a quorum decides the view change
+        if pending is not None and pending["decide"] == t:
+            nm = pending["n"]
+            votes_alive = sum(1 for v in pending["voters"] if alive(v, t))
+            if votes_alive < nm - (nm - 1) // 4:
+                raise ChurnEnvelopeError(
+                    f"tick {t}: only {votes_alive}/{nm} fast-round votes "
+                    "survive to the decide tick — no fast quorum, the "
+                    "oracle would fall back to classic paxos")
+            if not any(alive(m, t) for m in members):
+                raise ChurnEnvelopeError(
+                    f"tick {t}: no alive member left to count the votes")
+            dsts = pending["dsts"]
+            removed = sorted(d for d in dsts if d in members)
+            joined = [d for d in dsts if d not in members]
+            for d in removed:
+                view.ring_delete(endpoints[d])
+                members.discard(d)
+            for d in joined:
+                view.ring_add(endpoints[d], js[d]["node_id"])
+                members.add(d)
+            epoch += 1
+            fd_gate = t
+            fd_cnt.clear()
+            fd_notified.clear()
+            events.append((t, "view_change",
+                           view.get_current_configuration_id(),
+                           tuple(sorted(dsts))))
+            # Joiners get their parked SAFE_TO_JOIN response one hop
+            # later, in proposal (ring-0 hash) order -> service creation
+            # order for the batch pipeline.
+            for d in sorted(joined,
+                            key=lambda d: view.ring0_sort_key(endpoints[d])):
+                st = js[d]
+                st["done"] = True
+                wired[d] = t + 1
+                if not (t + 1 < st["start"] + settings.join_timeout_ticks):
+                    raise ChurnEnvelopeError(
+                        f"slot {d}: join decided at tick {t} but the "
+                        f"response at {t + 1} loses to the timeout retry "
+                        f"scheduled at {st['start']}+"
+                        f"{settings.join_timeout_ticks}")
+                if crashes and (t + 1) % interval == 0:
+                    raise ChurnEnvelopeError(
+                        f"slot {d}: wired at tick {t + 1}, an FD-interval "
+                        "multiple — under crash faults the joiner's "
+                        "detectors would skip it but the engine's fd_gate "
+                        "would not")
+                if not alive(d, t + 1):
+                    raise ChurnEnvelopeError(
+                        f"slot {d}: joiner crashes before its wiring "
+                        f"response at tick {t + 1}")
+                creation_order.append(d)
+            pending = None
+
+        # -- B: the flushed alert burst lands; H-crossing announces ------
+        if pending is not None and pending["announce"] == t:
+            emitted = announce_sim(pending["dsts"], t)
+            if emitted is None:
+                raise ChurnEnvelopeError(
+                    f"tick {t}: burst {sorted(pending['dsts'])} never "
+                    "emits a proposal (a destination is short of H "
+                    "distinct-ring reports or stuck in flux)")
+            if emitted != set(pending["dsts"]):
+                raise ChurnEnvelopeError(
+                    f"tick {t}: the oracle emits a partial proposal "
+                    f"{sorted(emitted)} != scheduled "
+                    f"{sorted(pending['dsts'])} (mid-batch H-crossing "
+                    "with zero in-flux destinations)")
+            voters = {m for m in members if alive(m, t)}
+            if not voters:
+                raise ChurnEnvelopeError(
+                    f"tick {t}: no alive member left to announce")
+            events.append((t, "proposal",
+                           view.get_current_configuration_id(),
+                           tuple(sorted(pending["dsts"]))))
+            pending["voters"] = voters
+            pending["n"] = len(members)
+
+        new_enq: List[Tuple[int, str]] = []
+
+        # -- C: two-phase join gatekeeping (host protocol mirror) --------
+        for s in sorted(js):
+            st = js[s]
+            if st["done"]:
+                continue
+            p1 = st["start"] + 1  # PreJoin hop: seed evaluates phase 1
+            if t == p1:
+                if seed_slot not in members:
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: join seed {seed_slot} is no longer a "
+                        f"member at tick {t}")
+                if not alive(seed_slot, t) or not alive(seed_slot, t + 1) \
+                        or not alive(s, t + 1):
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: seed or joiner dies during the "
+                        f"phase-1 exchange around tick {t}")
+                status = view.is_safe_to_join(endpoints[s], st["node_id"])
+                if status is JoinStatusCode.HOSTNAME_ALREADY_IN_RING:
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: endpoint already in the ring at its "
+                        f"phase-1 evaluation (tick {t}) — rejoin before "
+                        "removal is outside the envelope")
+                if status is JoinStatusCode.UUID_ALREADY_IN_RING:
+                    st["attempt"] += 1
+                    if st["attempt"] > settings.join_attempts:
+                        raise ChurnEnvelopeError(
+                            f"slot {s}: {settings.join_attempts} join "
+                            "attempts exhausted on UUID collisions")
+                    st["node_id"] = NodeId(st["rng"].getrandbits(64),
+                                           st["rng"].getrandbits(64))
+                    st["start"] = t + 1  # retry PreJoin goes out with the reply
+                    continue
+                st["p1_epoch"] = epoch
+                st["enq"] = t + 2  # reply hop + JoinMessage hop
+            elif st["enq"] == t:
+                if epoch != st["p1_epoch"]:
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: view changed between join phase 1 and "
+                        f"the gatekeeper enqueue at tick {t} — the oracle "
+                        "answers CONFIG_CHANGED and retries")
+                if not alive(s, t):
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: joiner crashes before its "
+                        f"JoinMessages deliver at tick {t}")
+                new_enq.append((s, "join"))
+            elif st["enq"] is None \
+                    and t >= st["start"] + settings.join_timeout_ticks:
+                raise ChurnEnvelopeError(
+                    f"slot {s}: join attempt times out undecided at "
+                    f"tick {t}")
+
+        # -- D: graceful leaves (LeaveMessage hop) -----------------------
+        for s, t0 in sorted(leaves.items()):
+            if t == t0:
+                if s not in members:
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: leave_gracefully() at tick {t} but the "
+                        "slot is not a member")
+                if not alive(s, t):
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: leaver already crashed at its "
+                        f"leave_gracefully() tick {t}")
+                leave_epochs[s] = epoch  # observers resolved against this view
+            elif t == t0 + 1:
+                if not alive(s, t):
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: leaver crashes before its "
+                        f"LeaveMessages deliver at tick {t}")
+                if leave_epochs.get(s) != epoch:
+                    raise ChurnEnvelopeError(
+                        f"slot {s}: view changed during the LeaveMessage "
+                        f"hop ending at tick {t}")
+                new_enq.append((s, "leave"))
+
+        # -- E: failure-detector interval (notify bookkeeping) -----------
+        if t % interval == 0 and t > fd_gate:
+            for s in sorted(members):
+                if alive(s, t) or s in fd_notified:
+                    continue
+                if fd_cnt.get(s, 0) >= settings.fd_failure_threshold:
+                    fd_notified.add(s)
+                    new_enq.append((s, "crash"))
+                else:
+                    fd_cnt[s] = fd_cnt.get(s, 0) + 1
+
+        # -- F: enqueue into the (single) alert pipeline -----------------
+        if new_enq:
+            if pending is not None:
+                non_crash = [(s, k) for s, k in new_enq if k != "crash"]
+                if non_crash:
+                    raise ChurnEnvelopeError(
+                        f"tick {t}: churn alerts {non_crash} enqueued "
+                        "while the pipeline deciding at tick "
+                        f"{pending['decide']} is in flight — the oracle "
+                        "drops and retries them, the single-shot schedule "
+                        "cannot")
+                # Crash notifications enqueued mid-pipeline are dropped by
+                # the decide's reset on both sides; the FD re-notifies
+                # after the view change (fd_cnt/fd_notified clear at A).
+            else:
+                pending = {
+                    "enqueue": t,
+                    "announce": t + settings.churn_announce_delay_ticks,
+                    "decide": t + settings.churn_decide_delay_ticks,
+                    "dsts": {s: k for s, k in new_enq},
+                }
+                for s, kind in new_enq:
+                    if kind == "join":
+                        schedule.join_tick[s] = t
+                        schedule.join_epoch[s] = epoch
+                    elif kind == "leave":
+                        schedule.leave_tick[s] = t
+                        schedule.leave_epoch[s] = epoch
+
+    id_fps = np.zeros(c, np.uint64)
+    joiner_ids: Dict[int, NodeId] = {}
+    for s, st in js.items():
+        id_fps[s] = np.uint64(id_fingerprint(st["node_id"]))
+        joiner_ids[s] = st["node_id"]
+
+    return ChurnPlan(
+        schedule=schedule,
+        id_fps=id_fps,
+        joiner_ids=joiner_ids,
+        wired=wired,
+        events=events,
+        final_members=frozenset(members),
+        final_config_id=view.get_current_configuration_id(),
+    )
+
+
+def synthetic_churn_schedule(
+    c: int,
+    n_initial: int,
+    settings: Settings,
+    start: int = 10,
+    period: Optional[int] = None,
+    burst: int = 8,
+) -> Tuple[ChurnSchedule, np.ndarray, dict]:
+    """A sustained-churn workload for benchmarks (engine-only, no oracle).
+
+    Alternating join/leave bursts: cycle ``i`` activates ``burst`` fresh
+    dormant slots (epoch ``2i``) then gracefully removes exactly those
+    slots (epoch ``2i+1``), so membership oscillates between ``n_initial``
+    and ``n_initial + burst`` and every burst decides before the next
+    enqueues. Returns (schedule, id_fps, info) where ``info`` carries the
+    burst count and the tick of the last decide.
+    """
+    if period is None:
+        period = settings.churn_decide_delay_ticks + 3
+    if period <= settings.churn_decide_delay_ticks:
+        raise ValueError("period must exceed the enqueue->decide delay")
+    headroom = c - n_initial
+    cycles = headroom // burst
+    schedule = empty_schedule(c)
+    id_fps = np.zeros(c, np.uint64)
+    for s in range(n_initial, c):
+        id_fps[s] = np.uint64(hashing.hash64(s, seed=0x6964))
+    last_decide = 0
+    for cyc in range(cycles):
+        slots = range(n_initial + cyc * burst, n_initial + (cyc + 1) * burst)
+        jt = start + (2 * cyc) * period
+        lt = start + (2 * cyc + 1) * period
+        for s in slots:
+            schedule.join_tick[s] = jt
+            schedule.join_epoch[s] = 2 * cyc
+            schedule.leave_tick[s] = lt
+            schedule.leave_epoch[s] = 2 * cyc + 1
+        last_decide = lt + settings.churn_decide_delay_ticks
+    info = {"bursts": 2 * cycles, "burst_size": burst, "period": period,
+            "last_decide": last_decide}
+    return schedule, id_fps, info

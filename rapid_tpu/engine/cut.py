@@ -14,11 +14,19 @@ alive receiver processes the identical alert stream (crash-fault envelope,
 see ``state``), so evaluating the three conditions on the end-of-tick
 counts reproduces the sequential detector's emission tick and contents.
 
+Destinations are members (DOWN alerts: crashes and graceful leaves) or
+dormant joiner slots (UP alerts from their gatekeepers); the reporter for
+``(dst, ring)`` is ``obs_idx[dst, ring]`` for members and ``gk_idx`` for
+joiners — the oracle's ``get_observers_of`` vs
+``get_expected_observers_of`` split (MultiNodeCutDetector.java).
+
 ``invalidate_failing_edges`` is the fixpoint of: for every in-flux
 destination, each ring whose observer is itself in (pre-)proposal (count
 ``>= L``) is implicitly reported. The oracle iterates this once per
-received batch; monotone counts make the end-of-tick fixpoint land in the
-same place (the differential harness enforces it).
+received batch — and only once a link-DOWN event has been seen in the
+current configuration (``_seen_link_down_events``), which the
+``seen_down`` latch mirrors; monotone counts make the end-of-tick
+fixpoint land in the same place (the differential harness enforces it).
 """
 from __future__ import annotations
 
@@ -28,7 +36,7 @@ from rapid_tpu.engine.state import EngineState
 
 
 def deliver_reports(xp, state: EngineState, src_alive):
-    """bool [C, K]: reports landing in the detector this tick.
+    """bool [C, K]: monitor DOWN reports landing in the detector this tick.
 
     ``pending_deliver[obs, j]`` says observer ``obs`` reported its ring-j
     subject two ticks ago; re-index to (destination, ring) via ``obs_idx``
@@ -40,22 +48,50 @@ def deliver_reports(xp, state: EngineState, src_alive):
     return by_dst & src_alive[state.obs_idx]
 
 
-def aggregate(xp, state: EngineState, delivered, any_receiver, settings):
-    """Apply one tick of reports; returns (reports, announce_now, proposal).
+def deliver_churn_reports(xp, state: EngineState, src_alive):
+    """(down, up) bool [C, K]: churn-pipeline reports landing this tick.
+
+    ``churn_deliver[dst]`` says dst's scheduled join/leave alert batch was
+    flushed last tick: a graceful leave reaches dst's K observers (one
+    LeaveMessage each, so every ring reports), a join is enqueued at dst's
+    K gatekeepers with their ring numbers. Per-ring sources are
+    ``obs_idx`` for members (leavers), ``gk_idx`` for dormant joiners;
+    rings whose source crashed before the batch delivery are dropped,
+    exactly like the monitor path.
+    """
+    src = xp.where(state.member[:, None], state.obs_idx, state.gk_idx)
+    ok = state.churn_deliver[:, None] & src_alive[src]
+    down = ok & state.member[:, None]
+    up = ok & ~state.member[:, None]
+    return down, up
+
+
+def aggregate(xp, state: EngineState, delivered_down, delivered_up,
+              any_receiver, settings):
+    """Apply one tick of reports; returns (reports, seen_down,
+    announce_now, proposal).
 
     ``any_receiver`` gates on an alive node existing to process the batch
     (the shared detector stands in for every alive receiver's copy).
+    ``delivered_down`` are DOWN alerts (valid only for member dsts),
+    ``delivered_up`` UP alerts (valid only for non-member dsts) — the
+    oracle's ``_filter_alert`` presence checks.
     """
     lo, hi = settings.L, settings.H
     gate = any_receiver & ~state.announced
-    new = delivered & state.member[:, None] & gate
+    new_down = delivered_down & state.member[:, None] & gate
+    new_up = delivered_up & ~state.member[:, None] & gate
+    new = new_down | new_up
     reports = state.reports | new
+    seen_down = state.seen_down | new_down.any()
     any_new = new.any()
+
+    eff_obs = xp.where(state.member[:, None], state.obs_idx, state.gk_idx)
 
     def fix_body(r):
         counts = r.sum(axis=1)
         flux = (counts >= lo) & (counts < hi)
-        obs_in_sets = (counts >= lo)[state.obs_idx]
+        obs_in_sets = (counts >= lo)[eff_obs]
         add = flux[:, None] & obs_in_sets & ~r
         return r | add
 
@@ -69,12 +105,14 @@ def aggregate(xp, state: EngineState, delivered, any_receiver, settings):
                                     (r, xp.asarray(True)))
         return r_final
 
-    # Only iterate the fixpoint on ticks that actually delivered reports
-    # (the oracle runs invalidate only on batch receipt).
-    reports = lax.cond(any_new, fixpoint, lambda r: r, reports)
+    # Only iterate the fixpoint on ticks that actually delivered reports,
+    # and only once a DOWN alert has been seen in this configuration (the
+    # oracle runs invalidate per batch receipt, gated on
+    # ``_seen_link_down_events`` — pure join traffic never invalidates).
+    reports = lax.cond(any_new & seen_down, fixpoint, lambda r: r, reports)
 
     counts = reports.sum(axis=1)
     in_flux = ((counts >= lo) & (counts < hi)).any()
-    crossed = (counts >= hi) & state.member
+    crossed = counts >= hi
     announce_now = any_new & ~in_flux & crossed.any() & ~state.announced
-    return reports, announce_now, crossed
+    return reports, seen_down, announce_now, crossed

@@ -286,3 +286,178 @@ def run_differential(
         oracle_config_id=oracle_cfg,
         engine_config_id=state_config_id(final_state),
     )
+
+
+# ---------------------------------------------------------------------------
+# churn differential: joins + graceful leaves (+ crashes) vs the oracle
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ChurnDiffResult:
+    """Oracle vs engine vs planner for a dynamic-membership scenario.
+
+    Message counters are *not* compared here: the join/leave RPC traffic
+    (PreJoin, JoinMessage, LeaveMessage, streamed join responses) is
+    host-side protocol the engine deliberately does not send. The
+    bit-identical contract covers the protocol-visible stream — proposal
+    announcements, view-change decisions, their ticks, member slots and
+    64-bit configuration ids — plus the final membership.
+    """
+
+    n_initial: int
+    capacity: int
+    n_ticks: int
+    oracle_events: List[ViewEvent]
+    engine_events: List[ViewEvent]
+    plan_events: List[ViewEvent]
+    oracle_config_id: int
+    engine_config_id: int
+    plan_config_id: int
+    oracle_members: frozenset
+    engine_members: frozenset
+    plan_members: frozenset
+
+    def assert_identical(self) -> None:
+        assert self.engine_events == self.oracle_events, (
+            f"event streams diverged:\n engine: {self.engine_events}\n"
+            f" oracle: {self.oracle_events}")
+        assert self.plan_events == self.oracle_events, (
+            f"planner prediction diverged from the oracle:\n"
+            f" plan:   {self.plan_events}\n oracle: {self.oracle_events}")
+        assert self.engine_config_id == self.oracle_config_id \
+            == self.plan_config_id, (
+            f"final configuration ids diverged: engine "
+            f"{self.engine_config_id:#x}, oracle {self.oracle_config_id:#x}, "
+            f"plan {self.plan_config_id:#x}")
+        assert self.engine_members == self.oracle_members \
+            == self.plan_members, (
+            f"final memberships diverged: engine {sorted(self.engine_members)}"
+            f", oracle {sorted(self.oracle_members)}, "
+            f"plan {sorted(self.plan_members)}")
+
+
+def run_churn_differential(
+    n: int,
+    capacity: int,
+    n_ticks: int,
+    joins: Optional[Dict[int, int]] = None,
+    leaves: Optional[Dict[int, int]] = None,
+    crashes: Optional[Dict[int, int]] = None,
+    settings: Optional[Settings] = None,
+    seed_slot: int = 0,
+) -> ChurnDiffResult:
+    """Replay a join/leave/crash scenario through planner, oracle, engine.
+
+    Slots ``[0, n)`` boot as converged members; ``[n, capacity)`` are
+    dormant joiner slots. ``joins[s]`` is the tick slot ``s`` calls
+    ``Cluster.join(seed)``, ``leaves[s]`` the tick it calls
+    ``leave_gracefully()``, ``crashes[s]`` its crash tick. The planner
+    raises ``ChurnEnvelopeError`` for scenarios outside the bit-identical
+    envelope *before* either simulation runs.
+    """
+    from rapid_tpu.engine.churn import plan_churn
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.state import state_config_id
+    from rapid_tpu.engine.step import simulate
+
+    joins = dict(joins or {})
+    leaves = dict(leaves or {})
+    crashes = dict(crashes or {})
+    settings = settings or Settings()
+    endpoints = default_endpoints(capacity)
+    node_ids = default_node_ids(n)
+
+    # --- plan: host protocol mirror, raises if out of envelope ----------
+    plan = plan_churn(endpoints, n, node_ids, n_ticks, settings,
+                      joins=joins, leaves=leaves, crashes=crashes,
+                      seed_slot=seed_slot)
+
+    # --- oracle side ----------------------------------------------------
+    fault_model = CrashFault({endpoints[s]: t for s, t in crashes.items()}) \
+        if crashes else HEALTHY
+    network, clusters, recorders = boot_static_cluster(
+        settings, endpoints[:n], node_ids, fault_model)
+    # Pre-number every dormant slot so joiner events land on canonical
+    # slot indices (the recorders share one slot_of dict).
+    recorders[0]._slot_of.update(
+        {endpoints[s]: s for s in range(n, capacity)})
+
+    joiner_recorders: Dict[int, _Recorder] = {}
+    cluster_of: Dict[int, Cluster] = dict(enumerate(clusters))
+    for s in sorted(joins):
+        cluster = Cluster(network, endpoints[s], settings)
+        recorder = _Recorder(network, recorders[0]._slot_of)
+        recorder.subscribe(cluster)
+        cluster_of[s] = cluster
+        joiner_recorders[s] = recorder
+    # Host actions scheduled up front get the smallest scheduler handles,
+    # so same-tick operations run in (tick, slot) order ahead of message
+    # processing — the order the planner assumes.
+    ops = sorted([(t, s, "join") for s, t in joins.items()]
+                 + [(t, s, "leave") for s, t in leaves.items()])
+    seed_ep = endpoints[seed_slot]
+    for t, s, kind in ops:
+        if kind == "join":
+            network.at(t, lambda cl=cluster_of[s]: cl.join(seed_ep))
+        else:
+            network.at(t, lambda cl=cluster_of[s]: cl.leave_gracefully())
+    run_oracle(network, n_ticks)
+
+    # Reference stream: initial members that neither crash nor leave.
+    alive = [s for s in range(n) if s not in crashes and s not in leaves]
+    events_oracle = oracle_events(recorders, alive)
+    reference = events_oracle
+
+    # Leavers see a prefix of the reference (they vote on and apply their
+    # own removal before the service stops).
+    for s in leaves:
+        if s in crashes or s >= n:
+            continue
+        seen = recorders[s].events
+        assert seen == reference[:len(seen)], (
+            f"leaver {s} saw a non-prefix stream: {seen}")
+    # Joiners see the suffix after their wiring tick, once the boot
+    # VIEW_CHANGE their service fires at creation is dropped.
+    for s, recorder in joiner_recorders.items():
+        if s in crashes:
+            continue
+        wired = plan.wired.get(s)
+        assert wired is not None, f"joiner {s} never wired in the oracle run"
+        seen = [e for e in recorder.events
+                if not (e.kind == "view_change" and e.tick == wired)]
+        expect = [e for e in reference if e.tick > wired]
+        assert seen == expect[:len(seen)] and (
+            len(seen) == len(expect) or s in leaves), (
+            f"joiner {s} (wired {wired}) diverged: {seen} != {expect}")
+
+    oracle_view = cluster_of[alive[0]].membership_service.view
+    oracle_cfg = oracle_view.get_current_configuration_id()
+    oracle_members = frozenset(
+        recorders[0]._slot_of[e] for e in oracle_view.get_ring(0))
+
+    # --- engine side ----------------------------------------------------
+    uids = [uid_of(e) for e in endpoints]
+    id_fp_sum = MembershipView(settings.K, node_ids, [])._id_fp_sum
+    member0 = [True] * n + [False] * (capacity - n)
+    state = init_state(uids, id_fp_sum, settings, member=member0,
+                       id_fps=plan.id_fps)
+    faults = crash_faults(
+        [crashes.get(s, I32_MAX) for s in range(capacity)])
+    final_state, logs = simulate(state, faults, n_ticks, settings,
+                                 churn=plan.schedule)
+    engine_members = frozenset(
+        int(s) for s in np.nonzero(np.asarray(final_state.member))[0])
+
+    return ChurnDiffResult(
+        n_initial=n, capacity=capacity, n_ticks=n_ticks,
+        oracle_events=events_oracle,
+        engine_events=engine_events(logs),
+        plan_events=[ViewEvent(*e) for e in plan.events],
+        oracle_config_id=oracle_cfg,
+        engine_config_id=state_config_id(final_state),
+        plan_config_id=plan.final_config_id,
+        oracle_members=oracle_members,
+        engine_members=engine_members,
+        plan_members=plan.final_members,
+    )

@@ -4,29 +4,41 @@ Array layout
 ------------
 The engine works over a fixed *slot universe* of ``capacity`` slots, one per
 simulated node (slot order = node creation order, which for the oracle's
-static bootstrap equals endpoint order). All protocol state is slot-indexed:
+static bootstrap equals endpoint order). Slots beyond the initial
+membership are *dormant*: present in every array, excluded by the
+``member`` mask, and activated when a decided join proposal lands (see
+``rapid_tpu.engine.churn``). All protocol state is slot-indexed:
 
 - identity: 64-bit node uids as ``(hi, lo)`` uint32 limb pairs (TPUs have no
   native 64-bit ints; see ``rapid_tpu.hashing``), plus per-slot membership
-  fingerprints for the running configuration-id sums;
+  and identifier fingerprints for the running configuration-id sums;
 - topology: ``subj_idx[n, k]`` / ``obs_idx[n, k]`` — node ``n``'s ring-``k``
-  subject (predecessor) and observer (successor) slot, recomputed from the
-  shared hash order on every view change;
+  subject (predecessor) and observer (successor) slot, plus ``gk_idx`` —
+  a dormant slot's join gatekeepers — recomputed from the shared hash
+  order on every view change;
 - monitoring: per unique-subject tombstone counters ``fc`` and the
   notified-once latch, mirroring ``PingPongFailureDetector``;
 - alert pipeline: the oracle's enqueue -> flush(+1 tick) -> deliver(+1 tick)
-  path as two ``[capacity, K]`` report buffers;
+  path as two ``[capacity, K]`` report buffers, with a parallel
+  ``[capacity]`` churn pipeline for scheduled join/leave alerts;
 - cut detection: the per-(destination, ring) report matrix plus the
-  announced-proposal latch, mirroring ``MultiNodeCutDetector``;
-- consensus: the pending fast-round vote and its proposal fingerprint.
+  announced-proposal latch, mirroring ``MultiNodeCutDetector``; the
+  ``seen_down`` latch mirrors the detector's
+  ``_seen_link_down_events`` gate on edge invalidation;
+- consensus: the pending fast-round vote and its proposal fingerprint;
+- ``epoch`` counts decided view changes — the device-side stand-in for the
+  oracle's configuration-id checks at alert-enqueue time.
 
 Scenario envelope
 -----------------
 The engine reproduces the oracle bit-for-bit for *crash-fault* scenarios
-(``rapid_tpu.engine.diff`` asserts it): crashes make every alive receiver
-see the identical alert stream, so one shared cut-detector state stands in
-for all N per-node detectors. Fault models that split the receiver set
-(partitions) need per-node detector state — a roadmap item.
+plus scheduled join/leave churn (``rapid_tpu.engine.diff`` asserts it):
+crashes make every alive receiver see the identical alert stream, so one
+shared cut-detector state stands in for all N per-node detectors. Fault
+models that split the receiver set (partitions) need per-node detector
+state — a roadmap item. The churn envelope (what join/leave schedules the
+shared state reproduces exactly) is documented in
+``rapid_tpu.engine.churn``.
 """
 from __future__ import annotations
 
@@ -97,6 +109,8 @@ class EngineState(NamedTuple):
     uid_lo: object                    # u32 [C]
     mfp_hi: object                    # u32 [C] member-fingerprint limbs
     mfp_lo: object                    # u32 [C]
+    idfp_hi: object                   # u32 [C] identifier-fp limbs (joiners)
+    idfp_lo: object                   # u32 [C]
     idsum_hi: object                  # u32 scalar: identifier-fp sum
     idsum_lo: object                  # u32 scalar
     memsum_hi: object                 # u32 scalar: member-fp sum
@@ -104,6 +118,7 @@ class EngineState(NamedTuple):
     # topology (recomputed on view change)
     subj_idx: object                  # i32 [C, K]
     obs_idx: object                   # i32 [C, K]
+    gk_idx: object                    # i32 [C, K] join gatekeepers (dormant rows)
     fd_active: object                 # bool [C, K] first-ring slot per unique subject
     fd_first: object                  # i32 [C, K] first ring slot with same subject
     # monitoring
@@ -113,8 +128,12 @@ class EngineState(NamedTuple):
     # alert pipeline (per observer slot x ring, already ring-expanded)
     pending_flush: object             # bool [C, K]: notified at t, flushes t+1
     pending_deliver: object           # bool [C, K]: flushed at t, delivers t+1
+    # churn alert pipeline (per *destination* slot; sources via obs/gk_idx)
+    churn_flush: object               # bool [C]: enqueued at t, flushes t+1
+    churn_deliver: object             # bool [C]: flushed at t, delivers t+1
     # cut detection (shared detector of all alive receivers)
     reports: object                   # bool [C, K] per (dst, ring)
+    seen_down: object                 # bool scalar: DOWN alert seen this config
     announced: object                 # bool scalar
     proposal: object                  # bool [C] announced proposal mask
     announce_tick: object             # i32 scalar
@@ -122,6 +141,7 @@ class EngineState(NamedTuple):
     voters: object                    # bool [C] who voted at announce_tick
     phash_hi: object                  # u32 scalar proposal fingerprint
     phash_lo: object                  # u32 scalar
+    epoch: object                     # i32 scalar: decided view changes so far
 
 
 class StepLog(NamedTuple):
@@ -170,13 +190,20 @@ def state_config_id(state: EngineState) -> int:
 
 
 def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
-               start_tick: int = 0) -> EngineState:
-    """Build the engine state for a fully-converged membership.
+               start_tick: int = 0, member: Optional[Sequence[bool]] = None,
+               id_fps: Optional[Sequence[int]] = None) -> EngineState:
+    """Build the engine state for a converged membership plus dormant slots.
 
     ``uids`` are the 64-bit node identities in slot order (from
     ``membership_view.uid_of`` for oracle parity, or any synthetic uint64s
     for benchmarks); ``id_fp_sum`` is the oracle's identifier-fingerprint
-    sum (``MembershipView._id_fp_sum``), carried so configuration ids agree.
+    sum over the *initial members* (``MembershipView._id_fp_sum``), carried
+    so configuration ids agree. ``member`` marks the initially-active
+    slots (default: all); ``id_fps`` carries each dormant slot's
+    identifier fingerprint (``membership_view.id_fingerprint`` of the
+    NodeId it will join with), added to the identifier sum when its join
+    is decided. If ``settings.capacity`` exceeds ``len(uids)``, extra
+    inert dormant slots pad the universe to that capacity.
     """
     import jax.numpy as jnp
 
@@ -184,41 +211,60 @@ def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
     from rapid_tpu.oracle.membership_view import _SEED_MEMBER
 
     uids_np = np.asarray(uids, dtype=np.uint64)
+    member_np = (np.ones(len(uids_np), bool) if member is None
+                 else np.asarray(member, bool))
+    id_fps_np = (np.zeros(len(uids_np), np.uint64) if id_fps is None
+                 else np.asarray(id_fps, dtype=np.uint64))
+    if settings.capacity > len(uids_np):
+        pad = settings.capacity - len(uids_np)
+        pad_uids = np.asarray(
+            [hashing.hash64(i, seed=0x636170) for i in range(pad)],
+            dtype=np.uint64)
+        uids_np = np.concatenate([uids_np, pad_uids])
+        member_np = np.concatenate([member_np, np.zeros(pad, bool)])
+        id_fps_np = np.concatenate([id_fps_np, np.zeros(pad, np.uint64)])
     c = len(uids_np)
     k = settings.K
     uid_hi, uid_lo = hashing.np_to_limbs(uids_np)
     mhi, mlo = hashing.hash64_limbs(np, uid_hi, uid_lo, seed=_SEED_MEMBER)
-    memsum = sum(int(h) << 32 | int(l) for h, l in zip(mhi, mlo)) & hashing.MASK64
+    memsum = sum(int(h) << 32 | int(l)
+                 for h, l, m in zip(mhi, mlo, member_np) if m) & hashing.MASK64
+    ifp_hi, ifp_lo = hashing.np_to_limbs(id_fps_np)
     idh, idl = hashing.to_limbs(id_fp_sum)
     msh, msl = hashing.to_limbs(memsum)
 
-    member = jnp.ones((c,), bool)
+    member_arr = jnp.asarray(member_np)
     uid_hi = jnp.asarray(uid_hi)
     uid_lo = jnp.asarray(uid_lo)
-    subj_idx, obs_idx, fd_active, fd_first = build_topology(
-        jnp, uid_hi, uid_lo, member, k)
+    subj_idx, obs_idx, gk_idx, fd_active, fd_first = build_topology(
+        jnp, uid_hi, uid_lo, member_arr, k)
     zero_ck_i = jnp.zeros((c, k), jnp.int32)
     zero_ck_b = jnp.zeros((c, k), bool)
     u32 = lambda v: jnp.uint32(v)
     return EngineState(
         tick=jnp.int32(start_tick),
-        member=member,
+        member=member_arr,
         uid_hi=uid_hi, uid_lo=uid_lo,
         mfp_hi=jnp.asarray(mhi), mfp_lo=jnp.asarray(mlo),
+        idfp_hi=jnp.asarray(ifp_hi), idfp_lo=jnp.asarray(ifp_lo),
         idsum_hi=u32(idh), idsum_lo=u32(idl),
         memsum_hi=u32(msh), memsum_lo=u32(msl),
-        subj_idx=subj_idx, obs_idx=obs_idx,
+        subj_idx=subj_idx, obs_idx=obs_idx, gk_idx=gk_idx,
         fd_active=fd_active, fd_first=fd_first,
         fc=zero_ck_i, notified=zero_ck_b,
         fd_gate=jnp.int32(start_tick),
         pending_flush=zero_ck_b, pending_deliver=zero_ck_b,
+        churn_flush=jnp.zeros((c,), bool),
+        churn_deliver=jnp.zeros((c,), bool),
         reports=zero_ck_b,
+        seen_down=jnp.asarray(False),
         announced=jnp.asarray(False),
         proposal=jnp.zeros((c,), bool),
         announce_tick=jnp.int32(-1),
         vote_pending=jnp.asarray(False),
         voters=jnp.zeros((c,), bool),
         phash_hi=u32(0), phash_lo=u32(0),
+        epoch=jnp.int32(0),
     )
 
 

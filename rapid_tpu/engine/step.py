@@ -6,27 +6,37 @@ and within the delivery phase votes (sent during the previous tick's
 delivery phase) sort before alert batches (sent during its run_due phase):
 
 1. **decide** — fast-round votes sent at the announce tick arrive; a
-   quorum triggers the view change (membership shrink, limb-subtracting
-   the removed members' fingerprints from the membership sum, topology
-   rebuild, full monitor/cut/consensus reset, FD re-alignment via
-   ``fd_gate``);
-2. **deliver** — alert batches flushed last tick land in the cut
-   detector; an H-crossing with no destination in flux announces the
-   proposal and broadcasts the fast-round votes;
-3. **flush** — batches enqueued by last FD tick move to the delivery
-   buffer (the oracle's 1-tick batching-window quiescence);
-4. **monitor** — on global ticks ``t % fd_interval == 0`` past the
-   ``fd_gate``, every node probes its unique subjects and saturated
-   counters enqueue their DOWN alerts.
+   quorum triggers the view change (membership XOR with the proposal:
+   leavers/crashed limb-subtract their member fingerprints from the
+   membership sum, joiners limb-add theirs and fold their identifier
+   fingerprint into the identifier sum; topology rebuild, full
+   monitor/cut/consensus reset, FD re-alignment via ``fd_gate``, and an
+   ``epoch`` increment that expires any in-flight churn alerts — the
+   oracle's config-id filter);
+2. **deliver** — alert batches flushed last tick (monitor DOWNs plus the
+   churn pipeline's leave-DOWNs and join-UPs) land in the cut detector;
+   an H-crossing with no destination in flux announces the proposal and
+   broadcasts the fast-round votes;
+3. **flush** — batches enqueued by last FD tick (and churn alerts
+   injected last tick) move to the delivery buffers (the oracle's 1-tick
+   batching-window quiescence);
+4. **churn + monitor** — scheduled join/leave alerts whose epoch still
+   matches are injected into the churn pipeline (the oracle's
+   gatekeeper/observer enqueue tick); on global ticks
+   ``t % fd_interval == 0`` past the ``fd_gate``, every node probes its
+   unique subjects and saturated counters enqueue their DOWN alerts.
 
 ``step`` is pure and shape-static: ``engine_step`` is its jit, and
 ``simulate`` drives it through ``lax.scan`` inside a single jit so an
-n-tick run is one device dispatch. ``trace_count()`` exposes how many
-times the step body has been traced (tests assert a single compilation).
+n-tick run is one device dispatch. ``churn`` is an optional
+``ChurnSchedule`` pytree; passing None compiles the churn phase out.
+``trace_count()`` exposes how many times the step body has been traced
+(tests assert a single compilation).
 """
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,8 +58,8 @@ def trace_count() -> int:
     return _TRACE_COUNT
 
 
-def step(state: EngineState, faults: EngineFaults,
-         settings: Settings) -> tuple:
+def step(state: EngineState, faults: EngineFaults, settings: Settings,
+         churn=None) -> tuple:
     """Advance the engine by one tick; returns (new_state, StepLog)."""
     global _TRACE_COUNT
     _TRACE_COUNT += 1
@@ -77,39 +87,55 @@ def step(state: EngineState, faults: EngineFaults,
         votes_arriving, (state.member & ~crashed).sum(), 0).astype(jnp.int32)
 
     def do_view_change(_):
-        removed = state.proposal
-        member = state.member & ~removed
+        removed = state.proposal & state.member
+        joined = state.proposal & ~state.member
+        member = state.member ^ state.proposal
         rm = removed.astype(jnp.uint32)
+        jn = joined.astype(jnp.uint32)
         rhi, rlo = hashing.sum64(jnp, state.mfp_hi * rm, state.mfp_lo * rm)
+        ahi, alo = hashing.sum64(jnp, state.mfp_hi * jn, state.mfp_lo * jn)
         ms_hi, ms_lo = hashing.sub64(
             jnp, state.memsum_hi, state.memsum_lo, rhi, rlo)
+        ms_hi, ms_lo = hashing.add64(jnp, ms_hi, ms_lo, ahi, alo)
+        # Identifiers are remembered forever (MembershipView.java:51):
+        # joins add their id fingerprint, removals never subtract.
+        ihi, ilo = hashing.sum64(jnp, state.idfp_hi * jn, state.idfp_lo * jn)
+        id_hi, id_lo = hashing.add64(
+            jnp, state.idsum_hi, state.idsum_lo, ihi, ilo)
         topo = build_topology(jnp, state.uid_hi, state.uid_lo, member,
                               settings.K)
-        return (member, ms_hi, ms_lo) + topo
+        return (member, ms_hi, ms_lo, id_hi, id_lo) + topo
 
     def keep_view(_):
         return (state.member, state.memsum_hi, state.memsum_lo,
-                state.subj_idx, state.obs_idx, state.fd_active,
-                state.fd_first)
+                state.idsum_hi, state.idsum_lo,
+                state.subj_idx, state.obs_idx, state.gk_idx,
+                state.fd_active, state.fd_first)
 
-    (member, memsum_hi, memsum_lo, subj_idx, obs_idx, fd_active,
-     fd_first) = lax.cond(decide_now, do_view_change, keep_view, None)
+    (member, memsum_hi, memsum_lo, idsum_hi, idsum_lo, subj_idx, obs_idx,
+     gk_idx, fd_active, fd_first) = lax.cond(
+        decide_now, do_view_change, keep_view, None)
 
     mid = state._replace(
         tick=t, member=member,
         memsum_hi=memsum_hi, memsum_lo=memsum_lo,
-        subj_idx=subj_idx, obs_idx=obs_idx,
+        idsum_hi=idsum_hi, idsum_lo=idsum_lo,
+        subj_idx=subj_idx, obs_idx=obs_idx, gk_idx=gk_idx,
         fd_active=fd_active, fd_first=fd_first,
         fc=jnp.where(decide_now, 0, state.fc),
         notified=state.notified & ~decide_now,
         fd_gate=jnp.where(decide_now, t, state.fd_gate),
         pending_flush=state.pending_flush & ~decide_now,
         pending_deliver=state.pending_deliver & ~decide_now,
+        churn_flush=state.churn_flush & ~decide_now,
+        churn_deliver=state.churn_deliver & ~decide_now,
         reports=state.reports & ~decide_now,
+        seen_down=state.seen_down & ~decide_now,
         announced=state.announced & ~decide_now,
         proposal=state.proposal & ~decide_now,
         vote_pending=state.vote_pending & ~votes_arriving,
         voters=state.voters & ~decide_now,
+        epoch=state.epoch + decide_now.astype(jnp.int32),
     )
 
     # ---- phase 2: alert delivery, aggregation, announce + vote cast ----
@@ -117,14 +143,20 @@ def step(state: EngineState, faults: EngineFaults,
     batch_src = mid.pending_deliver.any(axis=1)
     flushers_alive = (batch_src & src_alive).sum().astype(jnp.int32)
     n_alive = (mid.member & ~crashed).sum().astype(jnp.int32)
-    delivered = cut.deliver_reports(jnp, mid, src_alive)
-    reports, announce_now, crossed = cut.aggregate(
-        jnp, mid, delivered, n_alive > 0, settings)
+    delivered_down = cut.deliver_reports(jnp, mid, src_alive)
+    delivered_up = jnp.zeros_like(delivered_down)
+    if churn is not None:
+        churn_down, churn_up = cut.deliver_churn_reports(jnp, mid, src_alive)
+        delivered_down = delivered_down | churn_down
+        delivered_up = churn_up
+    reports, seen_down, announce_now, crossed = cut.aggregate(
+        jnp, mid, delivered_down, delivered_up, n_alive > 0, settings)
 
     ph_hi, ph_lo = votes_mod.proposal_fingerprint(
         jnp, crossed, mid.uid_hi, mid.uid_lo)
     mid = mid._replace(
         reports=reports,
+        seen_down=seen_down,
         announced=mid.announced | announce_now,
         proposal=jnp.where(announce_now, crossed, mid.proposal),
         announce_tick=jnp.where(announce_now, t, mid.announce_tick),
@@ -144,9 +176,22 @@ def step(state: EngineState, faults: EngineFaults,
     flush_recipients = jnp.where(
         flusher_mask.any(), n_member_now, 0).astype(jnp.int32)
     mid = mid._replace(pending_deliver=mid.pending_flush,
-                       pending_flush=jnp.zeros_like(mid.pending_flush))
+                       pending_flush=jnp.zeros_like(mid.pending_flush),
+                       churn_deliver=mid.churn_flush,
+                       churn_flush=jnp.zeros_like(mid.churn_flush))
 
-    # ---- phase 4: failure-detector interval ----------------------------
+    # ---- phase 4a: churn alert injection (scheduled enqueue ticks) -----
+    if churn is not None:
+        # The enqueue fires only while the slot's epoch expectation holds:
+        # a view change in between expired the scheduled alert, exactly as
+        # the oracle's config-id check at enqueue would drop it.
+        join_now = ((t == churn.join_tick) & ~mid.member
+                    & (mid.epoch == churn.join_epoch))
+        leave_now = ((t == churn.leave_tick) & mid.member
+                     & (mid.epoch == churn.leave_epoch))
+        mid = mid._replace(churn_flush=mid.churn_flush | join_now | leave_now)
+
+    # ---- phase 4b: failure-detector interval ---------------------------
     is_fd = (t % settings.fd_interval_ticks == 0) & (t > mid.fd_gate)
     fc_new, notified_new, notify_exp, probes_sent, probes_failed = (
         monitor.monitor_tick(jnp, mid, faults, settings))
@@ -183,24 +228,26 @@ def step(state: EngineState, faults: EngineFaults,
 
 @partial(jax.jit, static_argnums=(2,))
 def engine_step(state: EngineState, faults: EngineFaults,
-                settings: Settings) -> tuple:
+                settings: Settings, churn=None) -> tuple:
     """One jitted tick — a single device dispatch per call."""
-    return step(state, faults, settings)
+    return step(state, faults, settings, churn)
 
 
 @partial(jax.jit, static_argnums=(2, 3))
-def _simulate(state, faults, n_ticks: int, settings: Settings):
+def _simulate(state, faults, n_ticks: int, settings: Settings, churn=None):
     def body(carry, _):
-        return step(carry, faults, settings)
+        return step(carry, faults, settings, churn)
 
     return lax.scan(body, state, None, length=n_ticks)
 
 
 def simulate(state: EngineState, faults: EngineFaults, n_ticks: int,
-             settings: Settings) -> tuple:
+             settings: Settings, churn=None) -> tuple:
     """Run ``n_ticks`` engine steps as one jitted ``lax.scan``.
 
     Returns (final_state, logs) where each ``logs`` field is stacked with
-    a leading ``n_ticks`` axis.
+    a leading ``n_ticks`` axis. ``churn`` is an optional ``ChurnSchedule``
+    (see ``rapid_tpu.engine.churn``); None compiles to the crash-only
+    engine.
     """
-    return _simulate(state, faults, int(n_ticks), settings)
+    return _simulate(state, faults, int(n_ticks), settings, churn)

@@ -6,22 +6,50 @@ uid as tiebreak — the *same* sort key the oracle's ``MembershipView`` uses
 collision), computed with the *same* ``hash64_limbs`` — so ring order agrees
 by construction (SURVEY.md §7 "hash parity").
 
-Non-members sort after all members via a leading non-member key, so one
-``lexsort`` over the full slot universe yields members in ring order as a
-prefix; successors/predecessors wrap around within that prefix. Everything
-is shape-static and jit-compatible: membership changes only flip the
-``member`` mask and re-run the sort.
+One lexsort per ring orders the *full slot universe* — members and dormant
+slots interleaved. Members are then linked by nearest-member prefix scans
+(cummax/cummin over member positions), which yields two things from the
+same sort:
+
+- member ring neighbours: predecessor = subject, successor = observer;
+- joiner gatekeepers: a dormant slot's nearest member *predecessor* is
+  exactly the oracle's ``get_expected_observers_of`` — the predecessor of
+  the joiner's would-be ring position (MembershipView.java:292-303).
+
+Everything is shape-static and jit-compatible: membership changes only
+flip the ``member`` mask and re-run the sort.
 """
 from __future__ import annotations
+
+import numpy as np
 
 from rapid_tpu import hashing
 
 
+def _cummax(xp, x):
+    if xp is np:
+        return np.maximum.accumulate(x)
+    from jax import lax
+
+    return lax.cummax(x, axis=0)
+
+
+def _cummin_rev(xp, x):
+    if xp is np:
+        return np.minimum.accumulate(x[::-1])[::-1]
+    from jax import lax
+
+    return lax.cummin(x, axis=0, reverse=True)
+
+
 def build_topology(xp, uid_hi, uid_lo, member, k: int):
-    """Compute (subj_idx, obs_idx, fd_active, fd_first), each ``[C, K]``.
+    """Compute (subj_idx, obs_idx, gk_idx, fd_active, fd_first), each ``[C, K]``.
 
     - ``subj_idx[n, j]``: slot of node n's ring-j subject (predecessor);
     - ``obs_idx[n, j]``: slot of node n's ring-j observer (successor);
+    - ``gk_idx[n, j]``: for a *non-member* slot n, its ring-j join
+      gatekeeper (the member preceding its would-be position); member rows
+      point at themselves;
     - ``fd_active[n, j]``: True on the *first* ring slot of each unique
       subject of n — the oracle creates one failure detector per unique
       subject (``MembershipService._create_failure_detectors`` dedupes in
@@ -30,29 +58,49 @@ def build_topology(xp, uid_hi, uid_lo, member, k: int):
       j (= j itself where ``fd_active``), used to fan a notification back
       out to every ring it covers.
 
-    Non-member rows point at themselves and are fully masked.
+    Non-member rows of ``subj_idx``/``obs_idx`` point at themselves and are
+    fully masked.
     """
     c = uid_hi.shape[0]
     member = member.astype(bool)
     n = member.sum().astype(xp.int32)
     slots = xp.arange(c, dtype=xp.int32)
-    nonmember_key = (~member).astype(xp.uint32)
+    pos = xp.arange(c, dtype=xp.int32)
 
     subj_cols = []
     obs_cols = []
+    gk_cols = []
     for ring in range(k):
         khi, klo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=ring)
-        # last key is primary: (nonmember, key_hi, key_lo, uid_hi, uid_lo)
-        order = xp.lexsort((uid_lo, uid_hi, klo, khi, nonmember_key))
-        order = order.astype(xp.int32)
+        # last key is primary: (key_hi, key_lo, uid_hi, uid_lo)
+        order = xp.lexsort((uid_lo, uid_hi, klo, khi)).astype(xp.int32)
+        member_s = member[order]
+
+        # Nearest member strictly before each sorted position (wrap to the
+        # last member overall); -1 only when there are no members at all.
+        midx = xp.where(member_s, pos, xp.int32(-1))
+        incl = _cummax(xp, midx)
+        prev = xp.concatenate([xp.full((1,), -1, xp.int32), incl[:-1]])
+        prev = xp.where(prev < 0, incl[-1], prev)
+        prev = xp.maximum(prev, 0)  # safe gather when memberless
+
+        # Nearest member strictly after each sorted position (wrap to the
+        # first member overall); sentinel c when there are none.
+        nidx = xp.where(member_s, pos, xp.int32(c))
+        incl_n = _cummin_rev(xp, nidx)
+        nxt = xp.concatenate([incl_n[1:], xp.full((1,), c, xp.int32)])
+        first_m = xp.minimum(incl_n[0], c - 1)
+        nxt = xp.where(nxt >= c, first_m, nxt)
+
         rank = xp.argsort(order).astype(xp.int32)  # rank[slot] = ring position
-        nn = xp.maximum(n, 1)
-        succ = order[(rank + 1) % nn]
-        pred = order[(rank - 1) % nn]
+        pred = order[prev][rank]
+        succ = order[nxt][rank]
         subj_cols.append(xp.where(member, pred, slots))
         obs_cols.append(xp.where(member, succ, slots))
+        gk_cols.append(xp.where(member, slots, pred))
     subj_idx = xp.stack(subj_cols, axis=1)
     obs_idx = xp.stack(obs_cols, axis=1)
+    gk_idx = xp.stack(gk_cols, axis=1)
 
     # Dedup per unique subject: slot j is active iff no earlier ring slot
     # has the same subject. eq[n, j, i] = subj[n, j] == subj[n, i].
@@ -62,4 +110,4 @@ def build_topology(xp, uid_hi, uid_lo, member, k: int):
     fd_active = ~(eq & earlier).any(axis=2) & usable[:, None]
     # First ring slot with the same subject (argmax finds the first True).
     fd_first = xp.argmax(eq, axis=2).astype(xp.int32)
-    return subj_idx, obs_idx, fd_active, fd_first
+    return subj_idx, obs_idx, gk_idx, fd_active, fd_first

@@ -42,6 +42,13 @@ class JoinError(RuntimeError):
     pass
 
 
+def default_rng(settings: Settings, listen_address: Endpoint) -> random.Random:
+    """The rng a ``Cluster`` built without an explicit one draws NodeIds
+    from. Exposed so host-side planners (``rapid_tpu.engine.churn``) can
+    replicate a joiner's identifier sequence without creating the node."""
+    return random.Random(hash((settings.seed, str(listen_address))) & 0xFFFFFFFF)
+
+
 class Cluster:
     """One simulated cluster member."""
 
@@ -54,8 +61,7 @@ class Cluster:
         self.listen_address = listen_address
         self.settings = settings or network.settings
         self.metadata = dict(metadata or {})
-        self.rng = rng or random.Random(
-            hash((self.settings.seed, str(listen_address))) & 0xFFFFFFFF)
+        self.rng = rng or default_rng(self.settings, listen_address)
         self.server = SimServer(network, listen_address)
         self.client = SimMessagingClient(network, listen_address)
         self.fd_factory = fd_factory or PingPongFailureDetectorFactory(

@@ -106,11 +106,29 @@ class Configuration:
     framework's checkpoint format (SURVEY.md §5 checkpoint/resume).
     """
 
-    def __init__(self, node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint]):
+    def __init__(self, node_ids: Sequence[NodeId], endpoints: Sequence[Endpoint],
+                 id_fp_sum: Optional[int] = None,
+                 member_fp_sum: Optional[int] = None):
         self.node_ids: Tuple[NodeId, ...] = tuple(node_ids)
         self.endpoints: Tuple[Endpoint, ...] = tuple(endpoints)
+        # A view snapshotting itself passes its incrementally maintained
+        # fingerprint sums; a Configuration deserialized from the wire
+        # recomputes them lazily.
+        self._id_fp_sum = id_fp_sum
+        self._member_fp_sum = member_fp_sum
 
     def get_configuration_id(self) -> int:
+        if self._id_fp_sum is None:
+            self._id_fp_sum = sum(
+                id_fingerprint(i) for i in self.node_ids) & MASK64
+        if self._member_fp_sum is None:
+            self._member_fp_sum = sum(
+                member_fingerprint(e) for e in self.endpoints) & MASK64
+        return configuration_id(self._id_fp_sum, self._member_fp_sum)
+
+    def recompute_configuration_id(self) -> int:
+        """Full O(N) re-hash, ignoring any cached sums — the equivalence
+        check for the incremental path."""
         id_sum = sum(id_fingerprint(i) for i in self.node_ids) & MASK64
         mem_sum = sum(member_fingerprint(e) for e in self.endpoints) & MASK64
         return configuration_id(id_sum, mem_sum)
@@ -252,9 +270,13 @@ class MembershipView:
         return configuration_id(self._id_fp_sum, self._member_fp_sum)
 
     def get_configuration(self) -> Configuration:
+        # Hand over the running sums: the snapshot's configuration id is
+        # then O(1) instead of an O(N) re-hash per joiner response.
         return Configuration(
             sorted(self._identifiers_seen, key=lambda i: (i.high, i.low)),
             self.get_ring(0),
+            id_fp_sum=self._id_fp_sum,
+            member_fp_sum=self._member_fp_sum,
         )
 
     def ring0_sort_key(self, endpoint: Endpoint):

@@ -205,6 +205,14 @@ class SimNetwork:
 
     # -- the tick loop -------------------------------------------------------
 
+    def at(self, tick: int, fn: Callable[[], None]) -> object:
+        """Schedule a host action (e.g. ``cluster.join``) at an absolute
+        tick. The handle is allocated now, so actions scheduled before the
+        simulation starts sort ahead of every message-processing task due
+        the same tick — host operations lead the tick, deterministically."""
+        assert tick >= self.tick, f"tick {tick} already passed ({self.tick})"
+        return self.scheduler.schedule(tick - self.tick, fn)
+
     def step(self) -> None:
         """Advance one tick: deliver due messages, then run due tasks."""
         before = self.counters.snapshot()

@@ -65,5 +65,32 @@ class Settings:
     def with_(self, **kw) -> "Settings":
         return replace(self, **kw)
 
+    # --- derived churn-pipeline delays (rapid_tpu.engine.churn) ---------
+    # All in ticks, measured against the oracle's scheduler: one hop per
+    # message, alert batches flush after one quiescent batching window.
+
+    @property
+    def join_enqueue_delay_ticks(self) -> int:
+        """``Cluster.join()`` call -> UP alerts enqueued at the
+        gatekeepers: PreJoin hop + phase-1 reply hop + JoinMessage hop."""
+        return 3
+
+    @property
+    def leave_enqueue_delay_ticks(self) -> int:
+        """``leave()`` call -> DOWN alerts enqueued at the observers: one
+        LeaveMessage hop."""
+        return 1
+
+    @property
+    def churn_announce_delay_ticks(self) -> int:
+        """Alert enqueue -> proposal announce: the batch flushes after one
+        quiescent batching window and takes one hop to deliver."""
+        return self.batching_window_ticks + 1
+
+    @property
+    def churn_decide_delay_ticks(self) -> int:
+        """Alert enqueue -> view-change decide: announce + one vote hop."""
+        return self.churn_announce_delay_ticks + 1
+
 
 DEFAULT_SETTINGS = Settings()

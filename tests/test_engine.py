@@ -50,7 +50,7 @@ def test_topology_matches_oracle(n):
     uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
     uid_hi, uid_lo = hashing.np_to_limbs(uids)
     member = jnp.ones((n,), bool)
-    subj_idx, obs_idx, fd_active, _ = build_topology(
+    subj_idx, obs_idx, _, fd_active, _ = build_topology(
         jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
     subj_idx = np.asarray(subj_idx)
     obs_idx = np.asarray(obs_idx)
@@ -78,7 +78,7 @@ def test_topology_nonmember_rows_masked():
     uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
     uid_hi, uid_lo = hashing.np_to_limbs(uids)
     member = jnp.asarray([True] * 6 + [False] * 2)
-    subj_idx, obs_idx, fd_active, _ = build_topology(
+    subj_idx, obs_idx, gk_idx, fd_active, _ = build_topology(
         jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
     assert np.all(np.asarray(subj_idx)[6:] == np.arange(6, 8)[:, None])
     assert np.all(np.asarray(obs_idx)[6:] == np.arange(6, 8)[:, None])
@@ -86,6 +86,30 @@ def test_topology_nonmember_rows_masked():
     # member rows never point at a non-member
     assert np.asarray(subj_idx)[:6].max() < 6
     assert np.asarray(obs_idx)[:6].max() < 6
+
+
+@pytest.mark.parametrize("n,extra", [(5, 3), (32, 4)])
+def test_topology_gatekeepers_match_oracle(n, extra):
+    import jax.numpy as jnp
+
+    endpoints, _, _ = make_members(n + extra)
+    view = MembershipView(SETTINGS.K,
+                          [NodeId(i + 1, (i + 1) * 7919) for i in range(n)],
+                          endpoints[:n])
+    uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
+    uid_hi, uid_lo = hashing.np_to_limbs(uids)
+    member = jnp.asarray([True] * n + [False] * extra)
+    _, _, gk_idx, _, _ = build_topology(
+        jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
+    gk_idx = np.asarray(gk_idx)
+
+    slot_of = {e: i for i, e in enumerate(endpoints)}
+    for s in range(n, n + extra):
+        oracle_gk = [slot_of[g]
+                     for g in view.get_expected_observers_of(endpoints[s])]
+        assert list(gk_idx[s]) == oracle_gk
+    # member rows of gk_idx are self-pointers
+    assert np.all(gk_idx[:n] == np.arange(n)[:, None])
 
 
 # ---------------------------------------------------------------------------
@@ -220,6 +244,34 @@ def test_bench_engine_emits_json_with_trailing_newline(capsys):
     assert payload["bench"] == "engine_tick"
     assert payload["n"] == 64
     assert payload["ticks_per_sec"] > 0
+    assert payload["final_members"] == 64
+
+
+def test_bench_engine_churn_scenario_writes_out_file(tmp_path):
+    import importlib.util
+    import json
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "benchmarks" \
+        / "bench_engine.py"
+    spec = importlib.util.spec_from_file_location("bench_engine", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = tmp_path / "bench.json"
+    rc = mod.main(["--scenario", "churn", "--n", "64", "--ticks", "40",
+                   "--burst", "4", "--seed", "7", "--out", str(out)])
+    assert rc == 0
+    text = out.read_text()
+    assert text.endswith("\n"), "BENCH JSON must end with a newline"
+    payload = json.loads(text)
+    assert payload["bench"] == "engine_tick"
+    assert payload["scenario"] == "churn"
+    assert payload["n"] == 64
+    assert payload["churn_bursts"] > 0
+    assert payload["decisions"] == payload["churn_bursts"]
+    assert payload["ticks_per_sec"] > 0
+    # every join burst decided and the matching leave burst decided too:
+    # membership oscillates back to n by the end of the run
     assert payload["final_members"] == 64
 
 

@@ -6,10 +6,15 @@ and contents, 64-bit configuration ids, and per-tick message counts
 (``DiffResult.assert_identical``). Scenarios respect the crash-burst
 envelope documented in ``rapid_tpu.engine.diff``: all crashes in a burst
 share their first failing FD tick.
+
+Churn differentials (``run_churn_differential``) triangulate a third
+party — the host planner — against oracle and engine; counters are not
+compared there (join/leave RPCs are host-side protocol by design).
 """
 import pytest
 
-from rapid_tpu.engine.diff import run_differential
+from rapid_tpu.engine.churn import ChurnEnvelopeError
+from rapid_tpu.engine.diff import run_churn_differential, run_differential
 
 
 def test_differential_n64_single_crash():
@@ -61,3 +66,104 @@ def test_differential_n256_large_burst():
     res.assert_identical()
     assert len(res.engine_events) == 2
     assert res.engine_events[1].slots == tuple(range(0, 64, 2))
+
+
+# ---------------------------------------------------------------------------
+# churn differentials: joins, graceful leaves, mixed churn + crash
+# ---------------------------------------------------------------------------
+
+
+def test_churn_differential_n64_join_burst():
+    res = run_churn_differential(n=64, capacity=68, n_ticks=40,
+                                 joins={64: 5, 65: 5, 66: 5, 67: 5})
+    res.assert_identical()
+    # join() at 5 -> PreJoin 6 -> reply 7 -> UP enqueue 8 -> flush 9 ->
+    # announce 10 -> decide 11
+    assert [(e.kind, e.tick, e.slots) for e in res.engine_events] == [
+        ("proposal", 10, (64, 65, 66, 67)),
+        ("view_change", 11, (64, 65, 66, 67)),
+    ]
+    assert res.engine_members == frozenset(range(68))
+
+
+def test_churn_differential_n64_leave_burst():
+    res = run_churn_differential(n=64, capacity=64, n_ticks=40,
+                                 leaves={3: 5, 17: 5, 40: 5})
+    res.assert_identical()
+    # leave at 5 -> DOWN enqueue 6 -> flush 7 -> announce 8 -> decide 9
+    assert [(e.kind, e.tick, e.slots) for e in res.engine_events] == [
+        ("proposal", 8, (3, 17, 40)),
+        ("view_change", 9, (3, 17, 40)),
+    ]
+    assert res.engine_members == frozenset(range(64)) - {3, 17, 40}
+
+
+def test_churn_differential_n64_mixed_crash_join_leave():
+    res = run_churn_differential(n=64, capacity=66, n_ticks=180,
+                                 crashes={3: 5, 17: 5, 40: 5},
+                                 joins={64: 120, 65: 120},
+                                 leaves={7: 140})
+    res.assert_identical()
+    assert [(e.kind, e.tick, e.slots) for e in res.engine_events] == [
+        ("proposal", 112, (3, 17, 40)), ("view_change", 113, (3, 17, 40)),
+        ("proposal", 125, (64, 65)), ("view_change", 126, (64, 65)),
+        ("proposal", 143, (7,)), ("view_change", 144, (7,)),
+    ]
+    assert res.engine_members == (frozenset(range(66))
+                                  - {3, 17, 40, 7})
+
+
+def test_churn_planner_predicts_oracle_partial_emission():
+    """The crash pair {4, 9} at n=64 makes the *real* oracle emit a
+    partial proposal (slot 4 crosses H while 9 is still below L), which
+    the batched engine cannot reproduce — the planner must reject the
+    scenario before either side runs."""
+    with pytest.raises(ChurnEnvelopeError, match="partial"):
+        run_churn_differential(n=64, capacity=65, n_ticks=130,
+                               crashes={4: 5, 9: 5}, joins={64: 118})
+
+
+def test_churn_differential_join_then_leave_same_slot():
+    res = run_churn_differential(n=16, capacity=18, n_ticks=60,
+                                 joins={16: 5}, leaves={16: 30})
+    res.assert_identical()
+    assert [e.slots for e in res.engine_events] == [(16,)] * 4
+    assert res.engine_members == frozenset(range(16))
+
+
+def test_churn_differential_n256_join_and_leave_bursts():
+    res = run_churn_differential(
+        n=256, capacity=260, n_ticks=60,
+        joins={s: 5 for s in range(256, 260)},
+        leaves={11: 30, 42: 30, 197: 30})
+    res.assert_identical()
+    assert [(e.kind, e.tick) for e in res.engine_events] == [
+        ("proposal", 10), ("view_change", 11),
+        ("proposal", 33), ("view_change", 34),
+    ]
+    assert res.engine_events[0].slots == (256, 257, 258, 259)
+    assert res.engine_events[2].slots == (11, 42, 197)
+    assert res.engine_members == frozenset(range(260)) - {11, 42, 197}
+
+
+def test_churn_planner_rejects_overlapping_pipeline():
+    # The leave alert (enqueue 10) lands while the join pipeline
+    # (enqueue 8, announce 10, decide 11) is still in flight.
+    with pytest.raises(ChurnEnvelopeError, match="in flight"):
+        run_churn_differential(n=16, capacity=17, n_ticks=40,
+                               joins={16: 5}, leaves={3: 9})
+
+
+def test_churn_planner_rejects_view_change_inside_leave_hop():
+    # The join decides at tick 11, exactly when slot 3's LeaveMessages
+    # (sent at 10) deliver: the observers were resolved against the old
+    # view, the ring numbers against the new one.
+    with pytest.raises(ChurnEnvelopeError, match="view changed"):
+        run_churn_differential(n=16, capacity=17, n_ticks=40,
+                               joins={16: 5}, leaves={3: 10})
+
+
+def test_churn_planner_rejects_leaver_crashing_mid_hop():
+    with pytest.raises(ChurnEnvelopeError, match="leaver"):
+        run_churn_differential(n=16, capacity=16, n_ticks=40,
+                               leaves={3: 5}, crashes={3: 6})

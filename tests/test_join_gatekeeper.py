@@ -1,0 +1,168 @@
+"""Two-phase join gatekeeper edge cases (oracle).
+
+The seed's phase-1 verdict and the gatekeepers' phase-2 config check are
+the paths the churn planner (``rapid_tpu.engine.churn``) mirrors
+host-side; these tests pin the oracle behaviors it relies on: departed
+UUIDs stay burned forever, stale phase-2 configs answer CONFIG_CHANGED
+(or stream the configuration when the joiner already made it in), and a
+join colliding with an in-progress cut proposal still converges through
+the retry machinery.
+"""
+import random
+
+from rapid_tpu.faults import CrashFault
+from rapid_tpu.oracle.cluster import Cluster, default_rng
+from rapid_tpu.oracle.simulation import SimNetwork
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, JoinMessage, JoinStatusCode, NodeId
+
+SETTINGS = Settings()
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint("10.0.0.1", 1234 + i)
+
+
+def node_id_of(i: int) -> NodeId:
+    """Replicate the first identifier a cluster at ep(i) draws."""
+    rng = default_rng(SETTINGS, ep(i))
+    return NodeId(rng.getrandbits(64), rng.getrandbits(64))
+
+
+def wait_until(network: SimNetwork, predicate, max_ticks: int = 1000) -> bool:
+    for _ in range(max_ticks):
+        if predicate():
+            return True
+        network.step()
+    return predicate()
+
+
+def boot(network: SimNetwork, n: int):
+    clusters = [Cluster(network, ep(0), SETTINGS).start()]
+    for i in range(1, n):
+        c = Cluster(network, ep(i), SETTINGS)
+        c.join(ep(0))
+        assert wait_until(network, lambda: c.is_active, 500)
+        clusters.append(c)
+    return clusters
+
+
+class ScriptedRng(random.Random):
+    """Yields a fixed prefix of getrandbits values, then real randomness."""
+
+    def __new__(cls, script, seed=12345):
+        return super().__new__(cls, seed)
+
+    def __init__(self, script, seed=12345):
+        super().__init__(seed)
+        self._script = list(script)
+
+    def getrandbits(self, k):
+        if self._script:
+            return self._script.pop(0)
+        return super().getrandbits(k)
+
+
+def test_rejoin_with_departed_uuid_retries_to_success():
+    network = SimNetwork(SETTINGS)
+    clusters = boot(network, 4)
+    leaver = clusters[2]
+    departed_id = node_id_of(2)
+    assert clusters[0].membership_service.view.is_identifier_present(
+        departed_id)
+
+    leaver.leave_gracefully()
+    assert wait_until(
+        network, lambda: clusters[0].get_membership_size() == 3, 200)
+    # The identifier stays burned even though the host slot is free.
+    view = clusters[0].membership_service.view
+    assert view.is_safe_to_join(ep(9), departed_id) \
+        is JoinStatusCode.UUID_ALREADY_IN_RING
+
+    # A joiner whose rng re-draws the departed UUID must burn one attempt
+    # on UUID_ALREADY_IN_RING and succeed with the next identifier.
+    rejoiner = Cluster(network, ep(9), SETTINGS,
+                       rng=ScriptedRng([departed_id.high, departed_id.low]))
+    rejoiner.join(ep(0))
+    assert wait_until(network, lambda: rejoiner.is_active, 500)
+    assert rejoiner.get_membership_size() == 4
+    assert not rejoiner.join_failed
+    assert not clusters[0].membership_service.view.is_host_present(ep(2))
+
+
+def test_rejoin_same_endpoint_after_leave():
+    network = SimNetwork(SETTINGS)
+    clusters = boot(network, 4)
+    leaver = clusters[1]
+    leaver.leave_gracefully()
+    assert wait_until(
+        network, lambda: clusters[0].get_membership_size() == 3, 200)
+
+    back = Cluster(network, ep(1), SETTINGS)
+    back.join(ep(0))
+    assert wait_until(network, lambda: back.is_active, 500)
+    assert clusters[0].get_membership_size() == 4
+
+
+def test_phase2_stale_config_answers_config_changed():
+    network = SimNetwork(SETTINGS)
+    clusters = boot(network, 3)
+    service = clusters[0].membership_service
+
+    replies = []
+    service._handle_join_phase2(JoinMessage(
+        sender=ep(7), node_id=NodeId(1, 2), configuration_id=0xDEAD,
+        ring_numbers=(0,), metadata=()), replies.append)
+    assert len(replies) == 1
+    assert replies[0].status_code is JoinStatusCode.CONFIG_CHANGED
+    assert replies[0].configuration_id \
+        == service.view.get_current_configuration_id()
+    # No UP alert was parked for the stale joiner.
+    assert ep(7) not in service._joiners_to_respond_to
+
+
+def test_phase2_stale_config_streams_already_added_joiner():
+    network = SimNetwork(SETTINGS)
+    clusters = boot(network, 3)
+    service = clusters[0].membership_service
+    member_ep = ep(1)
+    member_id = node_id_of(1)
+
+    replies = []
+    service._handle_join_phase2(JoinMessage(
+        sender=member_ep, node_id=member_id, configuration_id=0xDEAD,
+        ring_numbers=(0,), metadata=()), replies.append)
+    assert len(replies) == 1
+    assert replies[0].status_code is JoinStatusCode.SAFE_TO_JOIN
+    assert replies[0].configuration_id \
+        == service.view.get_current_configuration_id()
+    assert set(replies[0].endpoints) == {ep(0), ep(1), ep(2)}
+    assert member_id in replies[0].identifiers
+
+
+def test_join_during_in_progress_cut_proposal_converges():
+    crash_at = 30
+    network = SimNetwork(SETTINGS, CrashFault({ep(3): crash_at}))
+    clusters = boot(network, 8)
+    boot_done = network.tick
+
+    # The crash is detected at the first FD multiple past the boot churn;
+    # launch joins shortly before the proposal pipeline so the phase-1/2
+    # exchanges straddle the announced cut. Some attempts eat
+    # CONFIG_CHANGED and retry; all must converge.
+    detect_eta = ((boot_done // SETTINGS.fd_interval_ticks) + 1) \
+        * SETTINGS.fd_interval_ticks \
+        + SETTINGS.fd_failure_threshold * SETTINGS.fd_interval_ticks
+    joiners = [Cluster(network, ep(20 + i), SETTINGS) for i in range(2)]
+    for i, joiner in enumerate(joiners):
+        network.at(detect_eta - 1 + i, lambda c=joiner: c.join(ep(0)))
+
+    assert wait_until(
+        network,
+        lambda: all(j.is_active for j in joiners)
+        and clusters[0].get_membership_size() == 9,
+        1500)
+    sizes = {c.get_membership_size()
+             for c in clusters + joiners if c is not clusters[3]}
+    assert sizes == {9}  # 8 booted - 1 crashed + 2 joined
+    assert not any(j.join_failed for j in joiners)

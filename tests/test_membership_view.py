@@ -234,3 +234,21 @@ def test_configuration_snapshot_roundtrip():
         assert restored.get_ring(k) == view.get_ring(k)
     for n in view.get_ring(0)[:10]:
         assert restored.get_observers_of(n) == view.get_observers_of(n)
+
+
+def test_incremental_configuration_id_matches_recompute():
+    """The view maintains its configuration id incrementally (modular sums
+    updated on ring_add/ring_delete); a full O(N) re-hash over the snapshot
+    must agree after every mutation."""
+    view = MembershipView(K)
+    for i in range(200):
+        view.ring_add(ep(i), NodeId(i * 3 + 1, i * 5 + 2))
+        if i % 7 == 0:
+            cfg = view.get_configuration()
+            assert cfg.get_configuration_id() == cfg.recompute_configuration_id()
+            assert cfg.get_configuration_id() == view.get_current_configuration_id()
+    for i in range(0, 200, 3):
+        view.ring_delete(ep(i))
+        cfg = view.get_configuration()
+        assert cfg.get_configuration_id() == cfg.recompute_configuration_id()
+        assert cfg.get_configuration_id() == view.get_current_configuration_id()

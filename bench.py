@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Repo-root benchmark shim: one small steady + churn suite, JSON out.
+
+This is the harness entry point (``python bench.py``): it runs the
+engine tick benchmark twice — an N=1k steady crash-burst and an N=1k
+sustained-churn run — with defaults small enough to finish quickly on
+CPU, and emits a single ``engine_tick_suite`` JSON payload (with
+trailing newline) on stdout or to ``--out FILE``. Each sub-payload
+carries the per-run protocol summary in its ``telemetry`` block
+(``rapid_tpu.telemetry.metrics.RunSummary``), validatable with::
+
+    python -m rapid_tpu.telemetry.schema BENCH.json
+
+For sweeps, tracing, and scenario knobs use the full benchmark:
+``python benchmarks/bench_engine.py --help``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from benchmarks.bench_engine import run, run_churn  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--n", type=int, default=1_000,
+                        help="simulated cluster size (default 1k)")
+    parser.add_argument("--ticks", type=int, default=120,
+                        help="simulated ticks per run (default 120)")
+    parser.add_argument("--burst", type=int, default=8,
+                        help="churn run: slots per join/leave burst")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="perturbs the synthetic node identities")
+    parser.add_argument("--out", type=str, default=None,
+                        help="write the JSON artifact to FILE "
+                             "(default: stdout)")
+    args = parser.parse_args(argv)
+
+    from rapid_tpu.settings import Settings
+
+    settings = Settings()
+    payload = {
+        "bench": "engine_tick_suite",
+        "n": args.n,
+        "ticks": args.ticks,
+        "steady": run(args.n, args.ticks, crash_frac=0.01, crash_tick=5,
+                      settings=settings, seed=args.seed),
+        "churn": run_churn(args.n, args.ticks, args.burst, settings,
+                           args.seed),
+    }
+    text = json.dumps(payload, indent=2) + "\n"
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,17 +44,26 @@ def synthetic_uids(n: int, seed: int = 0) -> np.ndarray:
     return hashing.np_from_limbs(hi, lo)
 
 
+def _telemetry_block(logs) -> dict:
+    """Per-run protocol summary (RunSummary) from the engine's StepLog."""
+    from rapid_tpu.telemetry.metrics import engine_metrics, summarize
+
+    return summarize(engine_metrics(logs)).as_dict()
+
+
 def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
-        settings, seed: int = 0) -> dict:
+        settings, seed: int = 0, trace_writer=None) -> dict:
     import jax
 
     from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
     from rapid_tpu.engine.step import simulate
+    from rapid_tpu.telemetry.trace import trace_from_logs, wall_span
 
     uids = synthetic_uids(n, seed)
     boot_start = time.perf_counter()
-    state = init_state(uids, id_fp_sum=0, settings=settings)
-    jax.block_until_ready(state)
+    with wall_span(trace_writer, "init_state+topology", {"n": n}):
+        state = init_state(uids, id_fp_sum=0, settings=settings)
+        jax.block_until_ready(state)
     boot_s = time.perf_counter() - boot_start
 
     n_crash = max(1, int(n * crash_frac))
@@ -65,15 +74,21 @@ def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
 
     # First call compiles (trace + XLA); second call measures steady state.
     compile_start = time.perf_counter()
-    final, logs = simulate(state, faults, ticks, settings)
-    jax.block_until_ready((final, logs))
+    with wall_span(trace_writer, "jit_trace+compile", {"ticks": ticks}):
+        final, logs = simulate(state, faults, ticks, settings)
+        jax.block_until_ready((final, logs))
     compile_s = time.perf_counter() - compile_start
 
     run_start = time.perf_counter()
-    final, logs = simulate(state, faults, ticks, settings)
-    jax.block_until_ready((final, logs))
+    with wall_span(trace_writer, "device_dispatch", {"ticks": ticks}):
+        final, logs = simulate(state, faults, ticks, settings)
+        jax.block_until_ready((final, logs))
     wall_s = time.perf_counter() - run_start
 
+    if trace_writer is not None:
+        trace_from_logs(logs, settings, writer=trace_writer)
+
+    telemetry = _telemetry_block(logs)
     decisions = int(np.asarray(logs.decide_now).sum())
     announces = int(np.asarray(logs.announce_now).sum())
     ticks_per_sec = ticks / wall_s
@@ -92,10 +107,14 @@ def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
         "announcements": announces,
         "decisions": decisions,
         "final_members": int(np.asarray(final.member).sum()),
+        "ticks_to_first_decide": telemetry["ticks_to_first_decide"],
+        "messages_per_view_change": telemetry["messages_per_view_change"],
+        "telemetry": telemetry,
     }
 
 
-def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0) -> dict:
+def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0,
+              trace_writer=None) -> dict:
     """Sustained join/leave churn: membership oscillates between ``n`` and
     ``n + burst`` while the jitted scan reconfigures the view on every
     decided proposal."""
@@ -104,6 +123,7 @@ def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0) -> dict:
     from rapid_tpu.engine.churn import synthetic_churn_schedule
     from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
     from rapid_tpu.engine.step import simulate
+    from rapid_tpu.telemetry.trace import trace_from_logs, wall_span
 
     period = settings.churn_decide_delay_ticks + 3
     start = 10
@@ -113,27 +133,37 @@ def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0) -> dict:
     member = np.zeros(capacity, bool)
     member[:n] = True
 
-    schedule, id_fps, info = synthetic_churn_schedule(
-        capacity, n, settings, start=start, burst=burst, period=period)
+    with wall_span(trace_writer, "plan_churn",
+                   {"capacity": capacity, "burst": burst}):
+        schedule, id_fps, info = synthetic_churn_schedule(
+            capacity, n, settings, start=start, burst=burst, period=period)
 
     boot_start = time.perf_counter()
-    state = init_state(uids, id_fp_sum=0, settings=settings,
-                       member=member, id_fps=id_fps)
-    jax.block_until_ready(state)
+    with wall_span(trace_writer, "init_state+topology",
+                   {"n": n, "capacity": capacity}):
+        state = init_state(uids, id_fp_sum=0, settings=settings,
+                           member=member, id_fps=id_fps)
+        jax.block_until_ready(state)
     boot_s = time.perf_counter() - boot_start
 
     faults = crash_faults([I32_MAX] * capacity)
 
     compile_start = time.perf_counter()
-    final, logs = simulate(state, faults, ticks, settings, churn=schedule)
-    jax.block_until_ready((final, logs))
+    with wall_span(trace_writer, "jit_trace+compile", {"ticks": ticks}):
+        final, logs = simulate(state, faults, ticks, settings, churn=schedule)
+        jax.block_until_ready((final, logs))
     compile_s = time.perf_counter() - compile_start
 
     run_start = time.perf_counter()
-    final, logs = simulate(state, faults, ticks, settings, churn=schedule)
-    jax.block_until_ready((final, logs))
+    with wall_span(trace_writer, "device_dispatch", {"ticks": ticks}):
+        final, logs = simulate(state, faults, ticks, settings, churn=schedule)
+        jax.block_until_ready((final, logs))
     wall_s = time.perf_counter() - run_start
 
+    if trace_writer is not None:
+        trace_from_logs(logs, settings, writer=trace_writer)
+
+    telemetry = _telemetry_block(logs)
     decisions = int(np.asarray(logs.decide_now).sum())
     ticks_per_sec = ticks / wall_s
     return {
@@ -153,6 +183,9 @@ def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0) -> dict:
         "rounds_per_sec": round(ticks_per_sec / settings.fd_interval_ticks, 2),
         "decisions": decisions,
         "final_members": int(np.asarray(final.member).sum()),
+        "ticks_to_first_decide": telemetry["ticks_to_first_decide"],
+        "messages_per_view_change": telemetry["messages_per_view_change"],
+        "telemetry": telemetry,
     }
 
 
@@ -180,21 +213,38 @@ def main(argv=None) -> int:
                              "stdout)")
     parser.add_argument("--sweep", action="store_true",
                         help="run the BASELINE sweep n in {1k, 10k, 100k}")
+    parser.add_argument("--trace", type=str, default=None, metavar="FILE",
+                        help="write a Chrome/Perfetto trace-event JSON of "
+                             "the measured run (open at ui.perfetto.dev)")
+    parser.add_argument("--jax-profile", type=str, default=None,
+                        metavar="DIR",
+                        help="also capture a jax.profiler trace into DIR "
+                             "(TensorBoard/Perfetto-compatible)")
     args = parser.parse_args(argv)
 
+    if args.trace and args.sweep:
+        parser.error("--trace records one run; combine with --n, not --sweep")
+
     from rapid_tpu.settings import Settings
+    from rapid_tpu.telemetry.trace import TraceWriter, jax_profiler_trace
 
     settings = Settings(K=args.k)
+    writer = TraceWriter() if args.trace else None
     sizes = [1_000, 10_000, 100_000] if args.sweep else [args.n]
-    if args.scenario == "churn":
-        results = [run_churn(n, args.ticks, args.burst, settings, args.seed)
-                   for n in sizes]
-    else:
-        results = [run(n, args.ticks, args.crash_frac, args.crash_tick,
-                       settings, args.seed)
-                   for n in sizes]
+    with jax_profiler_trace(args.jax_profile):
+        if args.scenario == "churn":
+            results = [run_churn(n, args.ticks, args.burst, settings,
+                                 args.seed, trace_writer=writer)
+                       for n in sizes]
+        else:
+            results = [run(n, args.ticks, args.crash_frac, args.crash_tick,
+                           settings, args.seed, trace_writer=writer)
+                       for n in sizes]
     payload = results[0] if len(results) == 1 else {"bench": "engine_tick",
                                                     "sweep": results}
+    if writer is not None:
+        writer.write(args.trace)
+        payload["trace"] = args.trace
     # BENCH artifacts end with a newline (ADVICE.md round-5 nit).
     text = json.dumps(payload, indent=2) + "\n"
     if args.out:

@@ -25,7 +25,13 @@ from rapid_tpu.engine.state import (
     init_state,
     state_config_id,
 )
-from rapid_tpu.engine.step import engine_step, simulate, step, trace_count
+from rapid_tpu.engine.step import (
+    engine_step,
+    reset_trace_count,
+    simulate,
+    step,
+    trace_count,
+)
 from rapid_tpu.engine.topology import build_topology
 
 __all__ = [
@@ -40,6 +46,7 @@ __all__ = [
     "engine_step",
     "init_state",
     "plan_churn",
+    "reset_trace_count",
     "simulate",
     "state_config_id",
     "step",

@@ -69,19 +69,23 @@ def deliver_churn_reports(xp, state: EngineState, src_alive):
 def aggregate(xp, state: EngineState, delivered_down, delivered_up,
               any_receiver, settings):
     """Apply one tick of reports; returns (reports, seen_down,
-    announce_now, proposal).
+    announce_now, proposal, explicit_added, implicit_added).
 
     ``any_receiver`` gates on an alive node existing to process the batch
     (the shared detector stands in for every alive receiver's copy).
     ``delivered_down`` are DOWN alerts (valid only for member dsts),
     ``delivered_up`` UP alerts (valid only for non-member dsts) — the
-    oracle's ``_filter_alert`` presence checks.
+    oracle's ``_filter_alert`` presence checks. ``explicit_added`` counts
+    report cells filled by delivered alerts this tick, ``implicit_added``
+    the cells filled by the edge-invalidation fixpoint (telemetry gauges;
+    neither feeds back into the protocol state).
     """
     lo, hi = settings.L, settings.H
     gate = any_receiver & ~state.announced
     new_down = delivered_down & state.member[:, None] & gate
     new_up = delivered_up & ~state.member[:, None] & gate
     new = new_down | new_up
+    explicit_added = (new & ~state.reports).sum().astype(xp.int32)
     reports = state.reports | new
     seen_down = state.seen_down | new_down.any()
     any_new = new.any()
@@ -109,10 +113,13 @@ def aggregate(xp, state: EngineState, delivered_down, delivered_up,
     # and only once a DOWN alert has been seen in this configuration (the
     # oracle runs invalidate per batch receipt, gated on
     # ``_seen_link_down_events`` — pure join traffic never invalidates).
+    pre_fixpoint = reports.sum().astype(xp.int32)
     reports = lax.cond(any_new & seen_down, fixpoint, lambda r: r, reports)
+    implicit_added = reports.sum().astype(xp.int32) - pre_fixpoint
 
     counts = reports.sum(axis=1)
     in_flux = ((counts >= lo) & (counts < hi)).any()
     crossed = counts >= hi
     announce_now = any_new & ~in_flux & crossed.any() & ~state.announced
-    return reports, seen_down, announce_now, crossed
+    return (reports, seen_down, announce_now, crossed,
+            explicit_added, implicit_added)

@@ -31,7 +31,9 @@ seconds.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+import json
+import os
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -54,6 +56,34 @@ class ViewEvent:
     config_id: int          # at fire time: pre-change for proposals,
                             # post-change for view changes
     slots: Tuple[int, ...]  # proposed / removed slots, ascending
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"tick": self.tick, "kind": self.kind,
+                "config_id": self.config_id, "slots": list(self.slots)}
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "ViewEvent":
+        return ViewEvent(tick=int(d["tick"]), kind=str(d["kind"]),
+                         config_id=int(d["config_id"]),
+                         slots=tuple(int(s) for s in d["slots"]))
+
+
+def write_events_jsonl(events: Sequence[ViewEvent], path) -> None:
+    """One ViewEvent per line, so oracle and engine streams written to two
+    files diff cleanly with standard line tools."""
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e.as_dict(), sort_keys=True) + "\n")
+
+
+def read_events_jsonl(path) -> List[ViewEvent]:
+    out: List[ViewEvent] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(ViewEvent.from_dict(json.loads(line)))
+    return out
 
 
 def default_endpoints(n: int) -> List[Endpoint]:
@@ -91,6 +121,10 @@ class _Recorder:
                 self._network.tick, kind, change.configuration_id, slots))
         return callback
 
+    def write_jsonl(self, path) -> None:
+        """Dump this node's recorded stream for offline diffing."""
+        write_events_jsonl(self.events, path)
+
 
 def boot_static_cluster(
     settings: Settings,
@@ -125,12 +159,14 @@ def boot_static_cluster(
 
 
 def run_oracle(network: SimNetwork, n_ticks: int) -> List[Dict[str, int]]:
-    """Step the oracle ``n_ticks`` times; returns per-tick counter dicts."""
-    per_tick: List[Dict[str, int]] = []
+    """Step the oracle ``n_ticks`` times; returns per-tick counter dicts.
+
+    The same records accumulate on ``network.tick_history`` (the full
+    run), which ``telemetry.oracle_metrics`` consumes."""
+    start = len(network.tick_history)
     for _ in range(n_ticks):
         network.step()
-        per_tick.append(network.last_tick_counters.as_dict())
-    return per_tick
+    return [dict(d) for d in network.tick_history[start:]]
 
 
 def oracle_events(
@@ -216,6 +252,15 @@ def expand_counters(logs) -> List[Dict[str, int]]:
     return out
 
 
+def _raise_divergence(report, artifact: Optional[str]) -> None:
+    from rapid_tpu.telemetry.forensics import DivergenceError
+
+    path = artifact or os.environ.get("RAPID_TPU_FORENSICS")
+    if path:
+        report.write_jsonl(path)
+    raise DivergenceError(report, path)
+
+
 @dataclass
 class DiffResult:
     n: int
@@ -226,19 +271,37 @@ class DiffResult:
     engine_counters: List[Dict[str, int]]
     oracle_config_id: int
     engine_config_id: int
+    # unified TickMetrics streams (telemetry), populated by run_differential
+    engine_metrics: Optional[List] = None
+    oracle_metrics: Optional[List] = None
 
-    def assert_identical(self) -> None:
-        assert self.engine_events == self.oracle_events, (
-            f"event streams diverged:\n engine: {self.engine_events}\n"
-            f" oracle: {self.oracle_events}")
-        for t, (eng, orc) in enumerate(zip(self.engine_counters,
-                                           self.oracle_counters), start=1):
-            assert eng == orc, (
-                f"message counters diverged at tick {t}:\n"
-                f" engine: {eng}\n oracle: {orc}")
-        assert self.engine_config_id == self.oracle_config_id, (
-            f"final configuration ids diverged: "
-            f"{self.engine_config_id:#x} != {self.oracle_config_id:#x}")
+    def first_divergence(self):
+        """The earliest (tick, field) where engine and oracle disagree,
+        as a ``DivergenceReport`` with trailing context — None if
+        bit-identical."""
+        from rapid_tpu.telemetry import forensics as fz
+
+        div = fz.earliest([
+            fz.events_divergence(self.engine_events, self.oracle_events),
+            fz.counters_divergence(self.engine_counters,
+                                   self.oracle_counters),
+            fz.scalar_divergence("config_id", self.engine_config_id,
+                                 self.oracle_config_id, tick=self.n_ticks),
+        ])
+        if div is None:
+            return None
+        return fz.build_report(div, engine_metrics=self.engine_metrics,
+                               oracle_metrics=self.oracle_metrics,
+                               events=self.oracle_events)
+
+    def assert_identical(self, artifact: Optional[str] = None) -> None:
+        """Raise ``DivergenceError`` (an AssertionError) at the first
+        divergence, naming tick and field with context records; writes a
+        JSONL forensics artifact to ``artifact`` (or the path in the
+        ``RAPID_TPU_FORENSICS`` env var) when given."""
+        report = self.first_divergence()
+        if report is not None:
+            _raise_divergence(report, artifact)
 
 
 def run_differential(
@@ -277,6 +340,8 @@ def run_differential(
     faults = crash_faults([crash_ticks.get(s, I32_MAX) for s in range(n)])
     final_state, logs = simulate(state, faults, n_ticks, settings)
 
+    from rapid_tpu.telemetry import metrics as telemetry_metrics
+
     return DiffResult(
         n=n, n_ticks=n_ticks,
         oracle_events=events_oracle,
@@ -285,6 +350,9 @@ def run_differential(
         engine_counters=expand_counters(logs),
         oracle_config_id=oracle_cfg,
         engine_config_id=state_config_id(final_state),
+        engine_metrics=telemetry_metrics.engine_metrics(logs),
+        oracle_metrics=telemetry_metrics.oracle_metrics(
+            oracle_counts, events_oracle),
     )
 
 
@@ -317,24 +385,40 @@ class ChurnDiffResult:
     oracle_members: frozenset
     engine_members: frozenset
     plan_members: frozenset
+    # engine TickMetrics stream (telemetry); oracle counters are not
+    # compared for churn, so no oracle stream here
+    engine_metrics: Optional[List] = field(default=None)
 
-    def assert_identical(self) -> None:
-        assert self.engine_events == self.oracle_events, (
-            f"event streams diverged:\n engine: {self.engine_events}\n"
-            f" oracle: {self.oracle_events}")
-        assert self.plan_events == self.oracle_events, (
-            f"planner prediction diverged from the oracle:\n"
-            f" plan:   {self.plan_events}\n oracle: {self.oracle_events}")
-        assert self.engine_config_id == self.oracle_config_id \
-            == self.plan_config_id, (
-            f"final configuration ids diverged: engine "
-            f"{self.engine_config_id:#x}, oracle {self.oracle_config_id:#x}, "
-            f"plan {self.plan_config_id:#x}")
-        assert self.engine_members == self.oracle_members \
-            == self.plan_members, (
-            f"final memberships diverged: engine {sorted(self.engine_members)}"
-            f", oracle {sorted(self.oracle_members)}, "
-            f"plan {sorted(self.plan_members)}")
+    def first_divergence(self):
+        """Earliest disagreement across the engine/plan/oracle triangle
+        (``plan_*`` fields hold the planner's value in the engine slot),
+        as a ``DivergenceReport`` — None when all three agree."""
+        from rapid_tpu.telemetry import forensics as fz
+
+        div = fz.earliest([
+            fz.events_divergence(self.engine_events, self.oracle_events),
+            fz.events_divergence(self.plan_events, self.oracle_events,
+                                 prefix="plan_events"),
+            fz.scalar_divergence("config_id", self.engine_config_id,
+                                 self.oracle_config_id, tick=self.n_ticks),
+            fz.scalar_divergence("plan_config_id", self.plan_config_id,
+                                 self.oracle_config_id, tick=self.n_ticks),
+            fz.scalar_divergence("members", self.engine_members,
+                                 self.oracle_members, tick=self.n_ticks),
+            fz.scalar_divergence("plan_members", self.plan_members,
+                                 self.oracle_members, tick=self.n_ticks),
+        ])
+        if div is None:
+            return None
+        return fz.build_report(div, engine_metrics=self.engine_metrics,
+                               events=self.oracle_events)
+
+    def assert_identical(self, artifact: Optional[str] = None) -> None:
+        """Raise ``DivergenceError`` at the first triangle divergence;
+        see ``DiffResult.assert_identical`` for the artifact contract."""
+        report = self.first_divergence()
+        if report is not None:
+            _raise_divergence(report, artifact)
 
 
 def run_churn_differential(
@@ -449,7 +533,10 @@ def run_churn_differential(
     engine_members = frozenset(
         int(s) for s in np.nonzero(np.asarray(final_state.member))[0])
 
+    from rapid_tpu.telemetry import metrics as telemetry_metrics
+
     return ChurnDiffResult(
+        engine_metrics=telemetry_metrics.engine_metrics(logs),
         n_initial=n, capacity=capacity, n_ticks=n_ticks,
         oracle_events=events_oracle,
         engine_events=engine_events(logs),

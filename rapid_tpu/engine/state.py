@@ -151,6 +151,11 @@ class StepLog(NamedTuple):
     recipients), not products: at 100k nodes the products overflow int32 and
     jax without x64 has no int64, so the host computes ``sent = flushers *
     recipients`` etc. exactly in Python (see ``diff.expand_counters``).
+
+    The trailing gauge fields are protocol observables for the telemetry
+    layer (``rapid_tpu.telemetry``): end-of-tick snapshots of alert-pipeline
+    occupancy, cut-detector fill toward H, fast-round vote progress, and the
+    configuration epoch. They are log-only — nothing in the step reads them.
     """
 
     tick: object                      # i32
@@ -171,6 +176,14 @@ class StepLog(NamedTuple):
     vote_recipients: object           # i32
     vote_senders_alive: object        # i32: votes surviving src-crash check
     vote_deliver_alive: object        # i32
+    # --- telemetry gauges (end-of-tick snapshots) -----------------------
+    alerts_in_flight: object          # i32: alert batches in the pipeline
+    cut_reports: object               # i32: filled (dst, ring) report cells
+    implicit_reports: object          # i32: cells added by edge invalidation
+    vote_tally: object                # i32: best proposal's delivered votes
+    quorum: object                    # i32: fast quorum at the vote count
+    epoch: object                     # i32: config epoch after this tick
+    churn_injected: object            # i32: churn alerts enqueued this tick
 
 
 def config_id_limbs(xp, idsum_hi, idsum_lo, memsum_hi, memsum_lo):

@@ -58,6 +58,17 @@ def trace_count() -> int:
     return _TRACE_COUNT
 
 
+def reset_trace_count() -> None:
+    """Zero the trace counter.
+
+    Single-compilation assertions should call this first so they measure
+    their own traces, independent of which tests (and in which order)
+    already compiled the step at other shapes.
+    """
+    global _TRACE_COUNT
+    _TRACE_COUNT = 0
+
+
 def step(state: EngineState, faults: EngineFaults, settings: Settings,
          churn=None) -> tuple:
     """Advance the engine by one tick; returns (new_state, StepLog)."""
@@ -72,11 +83,15 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     valid = state.voters & ~crashed & votes_arriving
     n_member = state.member.sum().astype(jnp.int32)
     c = state.member.shape[0]
-    decided, _ = votes_mod.count_fast_round(
+    decided, tally = votes_mod.count_fast_round(
         jnp,
         jnp.broadcast_to(state.phash_hi, (c,)),
         jnp.broadcast_to(state.phash_lo, (c,)),
         valid, n_member)
+    vote_tally = jnp.where(votes_arriving, tally, 0).astype(jnp.int32)
+    vote_quorum = jnp.where(
+        votes_arriving, votes_mod.fast_quorum(jnp, n_member), 0
+    ).astype(jnp.int32)
     # A decision needs an alive receiver to count the votes.
     decide_now = votes_arriving & decided & (state.member & ~crashed).any()
     decision = state.proposal & decide_now
@@ -149,7 +164,8 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         churn_down, churn_up = cut.deliver_churn_reports(jnp, mid, src_alive)
         delivered_down = delivered_down | churn_down
         delivered_up = churn_up
-    reports, seen_down, announce_now, crossed = cut.aggregate(
+    (reports, seen_down, announce_now, crossed, _explicit_added,
+     implicit_added) = cut.aggregate(
         jnp, mid, delivered_down, delivered_up, n_alive > 0, settings)
 
     ph_hi, ph_lo = votes_mod.proposal_fingerprint(
@@ -190,6 +206,9 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         leave_now = ((t == churn.leave_tick) & mid.member
                      & (mid.epoch == churn.leave_epoch))
         mid = mid._replace(churn_flush=mid.churn_flush | join_now | leave_now)
+        churn_injected = (join_now | leave_now).sum().astype(jnp.int32)
+    else:
+        churn_injected = jnp.int32(0)
 
     # ---- phase 4b: failure-detector interval ---------------------------
     is_fd = (t % settings.fd_interval_ticks == 0) & (t > mid.fd_gate)
@@ -204,6 +223,12 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     cfg_hi, cfg_lo = config_id_limbs(
         jnp, new_state.idsum_hi, new_state.idsum_lo,
         new_state.memsum_hi, new_state.memsum_lo)
+    alerts_in_flight = (
+        new_state.pending_flush.any(axis=1).sum()
+        + new_state.pending_deliver.any(axis=1).sum()
+        + new_state.churn_flush.sum()
+        + new_state.churn_deliver.sum()
+    ).astype(jnp.int32)
     log = StepLog(
         tick=t,
         announce_now=announce_now,
@@ -222,6 +247,13 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         vote_recipients=vote_recipients,
         vote_senders_alive=vote_senders_alive,
         vote_deliver_alive=vote_deliver_alive,
+        alerts_in_flight=alerts_in_flight,
+        cut_reports=new_state.reports.sum().astype(jnp.int32),
+        implicit_reports=implicit_added,
+        vote_tally=vote_tally,
+        quorum=vote_quorum,
+        epoch=new_state.epoch,
+        churn_injected=churn_injected,
     )
     return new_state, log
 

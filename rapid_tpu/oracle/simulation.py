@@ -123,6 +123,10 @@ class SimNetwork:
         self.rpc_timeout_ticks = 2
         self.counters = NetworkCounters()       # cumulative
         self.last_tick_counters = NetworkCounters()  # delta of the last step()
+        # Per-tick counter deltas, one dict per step() in order — the
+        # oracle half of the telemetry layer's unified TickMetrics stream
+        # (rapid_tpu.telemetry.metrics.oracle_metrics).
+        self.tick_history: List[Dict[str, int]] = []
 
     @property
     def tick(self) -> int:
@@ -240,6 +244,7 @@ class SimNetwork:
                 server.handle(request, lambda resp: None)
         self.scheduler._run_due(t)
         self.last_tick_counters = self.counters.delta(before)
+        self.tick_history.append(self.last_tick_counters.as_dict())
 
     def _deliver_reply(self, src: Endpoint, dst: Endpoint, resp: object,
                        reply: ReplyFn) -> None:

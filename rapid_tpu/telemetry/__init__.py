@@ -1,0 +1,62 @@
+"""Observability for rapid-tpu: metrics, traces, and divergence forensics.
+
+Three layers over the same per-tick observables (Rapid §6's evaluation
+quantities — alert batches in flight, cut-detector fill between L and H,
+fast-round quorum progress, time-to-view-change):
+
+- ``metrics`` — ``TickMetrics`` normalizes engine ``StepLog`` rows and
+  oracle ``NetworkCounters`` deltas into one record stream (JSONL
+  round-trippable); ``summarize`` folds a stream into the per-run
+  ``RunSummary`` the benchmarks embed in their JSON payloads.
+- ``trace`` — Chrome/Perfetto trace-event export: virtual-time phase
+  slices and protocol instants from a run's logs, plus wall-clock spans
+  (``wall_span``) around jit trace, device dispatch, and churn planning.
+- ``forensics`` — first-divergence reports (tick, field, both values,
+  trailing context) raised as ``DivergenceError`` by the differential
+  harness instead of a bare AssertionError, with a JSONL artifact.
+- ``schema`` — structural validation of BENCH payloads for the tier-1
+  smoke step.
+"""
+from rapid_tpu.telemetry.forensics import (
+    Divergence,
+    DivergenceError,
+    DivergenceReport,
+)
+from rapid_tpu.telemetry.metrics import (
+    COUNTER_FIELDS,
+    UNOBSERVED,
+    RunSummary,
+    TickMetrics,
+    counters_equal,
+    engine_metrics,
+    oracle_metrics,
+    read_jsonl,
+    summarize,
+    write_jsonl,
+)
+from rapid_tpu.telemetry.trace import (
+    TraceWriter,
+    jax_profiler_trace,
+    trace_from_logs,
+    wall_span,
+)
+
+__all__ = [
+    "COUNTER_FIELDS",
+    "Divergence",
+    "DivergenceError",
+    "DivergenceReport",
+    "RunSummary",
+    "TickMetrics",
+    "TraceWriter",
+    "UNOBSERVED",
+    "counters_equal",
+    "engine_metrics",
+    "jax_profiler_trace",
+    "oracle_metrics",
+    "read_jsonl",
+    "summarize",
+    "trace_from_logs",
+    "wall_span",
+    "write_jsonl",
+]

@@ -1,0 +1,189 @@
+"""Divergence forensics: first-divergence reports for the differentials.
+
+``rapid_tpu.engine.diff`` used to fail with a bare ``AssertionError``
+dumping both event streams; at N=256 that is a wall of tuples with the
+actual divergence buried somewhere inside. This module locates the
+*first* point where engine and oracle disagree — by tick, then by field —
+and packages it with the last few ``TickMetrics``/``ViewEvent`` records
+of context as:
+
+- a readable exception message (``DivergenceError``, still an
+  ``AssertionError`` so existing harnesses keep working), and
+- an optional JSONL artifact (context records first, the divergence
+  record last) for offline diffing with standard tools.
+
+The finders return ``Divergence`` records; ``earliest`` picks the one
+with the smallest tick (list order breaking ties, so callers put their
+highest-signal comparison first).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+#: Event fields compared in order; the first mismatch names the field.
+_EVENT_FIELDS = ("tick", "kind", "slots", "config_id")
+
+
+def _jsonable(v):
+    if isinstance(v, (tuple, frozenset, set)):
+        return sorted(v) if isinstance(v, (set, frozenset)) else list(v)
+    return v
+
+
+@dataclass
+class Divergence:
+    """The first disagreeing (tick, field) pair between two streams."""
+
+    tick: int
+    field: str
+    engine: object  # our side's value (engine, or planner for plan_* fields)
+    oracle: object  # the reference side's value
+
+
+@dataclass
+class DivergenceReport:
+    """A located divergence plus trailing context records."""
+
+    tick: int
+    field: str
+    engine: object
+    oracle: object
+    context: List[Dict[str, object]] = field(default_factory=list)
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"record": "divergence", "tick": self.tick,
+                "field": self.field,
+                "engine": _jsonable(self.engine),
+                "oracle": _jsonable(self.oracle)}
+
+    def render(self) -> str:
+        lines = [
+            f"engine diverged from oracle at tick {self.tick}, "
+            f"field {self.field!r}:",
+            f"  engine: {self.engine!r}",
+            f"  oracle: {self.oracle!r}",
+        ]
+        if self.context:
+            lines.append(f"last {len(self.context)} records before the "
+                         f"divergence:")
+            for rec in self.context:
+                lines.append("  " + json.dumps(rec, sort_keys=True,
+                                               default=str))
+        return "\n".join(lines)
+
+    def write_jsonl(self, path) -> None:
+        """Context records first, the divergence record last."""
+        with open(path, "w") as fh:
+            for rec in self.context:
+                fh.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+            fh.write(json.dumps(self.as_dict(), sort_keys=True, default=str)
+                     + "\n")
+
+
+class DivergenceError(AssertionError):
+    """Raised by ``assert_identical`` with the located first divergence."""
+
+    def __init__(self, report: DivergenceReport,
+                 artifact: Optional[str] = None) -> None:
+        self.report = report
+        self.artifact = artifact
+        msg = report.render()
+        if artifact:
+            msg += f"\nforensics artifact: {artifact}"
+        super().__init__(msg)
+
+
+# ---------------------------------------------------------------------------
+# finders
+# ---------------------------------------------------------------------------
+
+
+def events_divergence(engine_events: Sequence, oracle_events: Sequence,
+                      prefix: str = "events") -> Optional[Divergence]:
+    """First field-level mismatch between two ViewEvent streams."""
+    for i, (ev, ov) in enumerate(zip(engine_events, oracle_events)):
+        for f in _EVENT_FIELDS:
+            evf, ovf = getattr(ev, f), getattr(ov, f)
+            if evf != ovf:
+                return Divergence(min(ev.tick, ov.tick),
+                                  f"{prefix}[{i}].{f}", evf, ovf)
+    if len(engine_events) != len(oracle_events):
+        i = min(len(engine_events), len(oracle_events))
+        longer = engine_events if len(engine_events) > len(oracle_events) \
+            else oracle_events
+        return Divergence(longer[i].tick, f"{prefix}.length",
+                          len(engine_events), len(oracle_events))
+    return None
+
+
+def counters_divergence(engine_counters: Sequence[Dict[str, int]],
+                        oracle_counters: Sequence[Dict[str, int]],
+                        start_tick: int = 0) -> Optional[Divergence]:
+    """First per-tick message-counter mismatch (tick = start_tick + 1 + i)."""
+    for i, (eng, orc) in enumerate(zip(engine_counters, oracle_counters)):
+        for key in sorted(set(eng) | set(orc)):
+            ev, ov = eng.get(key), orc.get(key)
+            if ev != ov:
+                return Divergence(start_tick + 1 + i, f"counters.{key}",
+                                  ev, ov)
+    return None
+
+
+def scalar_divergence(name: str, engine_value, oracle_value,
+                      tick: int) -> Optional[Divergence]:
+    """End-of-run scalar comparison (config ids, final memberships)."""
+    if engine_value != oracle_value:
+        return Divergence(tick, name, engine_value, oracle_value)
+    return None
+
+
+def earliest(candidates: Sequence[Optional[Divergence]]) \
+        -> Optional[Divergence]:
+    """The divergence with the smallest tick; list order breaks ties."""
+    found = [d for d in candidates if d is not None]
+    if not found:
+        return None
+    best = found[0]
+    for d in found[1:]:
+        if d.tick < best.tick:
+            best = d
+    return best
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+
+def build_report(div: Divergence,
+                 engine_metrics: Optional[Sequence] = None,
+                 oracle_metrics: Optional[Sequence] = None,
+                 events: Sequence = (),
+                 context_n: int = 4) -> DivergenceReport:
+    """Attach the last ``context_n`` records at/before the divergence tick.
+
+    Context records are tagged dicts: TickMetrics rows from each supplied
+    stream (``"record": "tick_metrics"``) and ViewEvents
+    (``"record": "view_event"``), all with tick <= the divergence tick.
+    """
+    context: List[Dict[str, object]] = []
+    for stream in (engine_metrics, oracle_metrics):
+        if not stream:
+            continue
+        rows = [m for m in stream if m.tick <= div.tick][-context_n:]
+        for m in rows:
+            rec = {"record": "tick_metrics"}
+            rec.update(m.as_dict())
+            context.append(rec)
+    for e in [e for e in events if e.tick <= div.tick][-context_n:]:
+        rec = {"record": "view_event"}
+        rec.update(e.as_dict() if hasattr(e, "as_dict")
+                   else dataclasses.asdict(e))
+        rec["slots"] = _jsonable(rec.get("slots"))
+        context.append(rec)
+    return DivergenceReport(tick=div.tick, field=div.field,
+                            engine=div.engine, oracle=div.oracle,
+                            context=context)

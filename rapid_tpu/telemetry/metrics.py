@@ -1,0 +1,260 @@
+"""Unified tick metrics: one record stream for engine and oracle.
+
+The engine logs per-tick sender/recipient *factors* (``StepLog``) that
+``rapid_tpu.engine.diff.expand_counters`` multiplies into exact message
+tallies; the oracle tallies the same traffic directly on its virtual
+network (``NetworkCounters`` deltas per ``SimNetwork.step``). This module
+normalizes both into ``TickMetrics`` — the record the differential
+harness compares, the forensics report quotes, and the trace exporter
+renders — plus ``RunSummary``, the per-run protocol summary the
+benchmarks embed in their JSON payloads.
+
+Counter fields (``COUNTER_FIELDS``) are observable on both sides and must
+agree tick-for-tick inside the crash-fault envelope. Gauge fields are
+engine-side protocol observables (alert-pipeline occupancy, cut-detector
+fill toward H, fast-round vote tally vs quorum, membership size, config
+epoch); the oracle does not export them, so they read ``UNOBSERVED`` on
+oracle records and are excluded from equality checks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+#: Gauge value on sources that do not observe the gauge (oracle records).
+UNOBSERVED = -1
+
+#: Fields observable on both sides; per-tick equality is asserted by the
+#: differential harness inside the crash-fault envelope.
+COUNTER_FIELDS = ("sent", "delivered", "dropped", "timeouts",
+                  "probes_sent", "probes_failed")
+
+
+@dataclass(frozen=True)
+class TickMetrics:
+    """One tick of one source ("engine" | "oracle"), normalized."""
+
+    tick: int
+    source: str
+    # message counters (exact, host-expanded)
+    sent: int = 0
+    delivered: int = 0
+    dropped: int = 0
+    timeouts: int = 0
+    probes_sent: int = 0
+    probes_failed: int = 0
+    # protocol gauges (engine-derived; UNOBSERVED on the oracle)
+    n_member: int = UNOBSERVED
+    epoch: int = UNOBSERVED
+    alerts_in_flight: int = UNOBSERVED
+    cut_reports: int = UNOBSERVED
+    implicit_reports: int = UNOBSERVED
+    vote_tally: int = UNOBSERVED
+    quorum: int = UNOBSERVED
+    churn_injected: int = UNOBSERVED
+    # protocol events at this tick
+    announce: bool = False
+    decide: bool = False
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "TickMetrics":
+        return TickMetrics(**d)
+
+    def counters(self) -> Dict[str, int]:
+        return {f: getattr(self, f) for f in COUNTER_FIELDS}
+
+
+def counters_equal(a: TickMetrics, b: TickMetrics) -> bool:
+    """Equality restricted to the fields both sources observe."""
+    return a.tick == b.tick and all(
+        getattr(a, f) == getattr(b, f) for f in COUNTER_FIELDS)
+
+
+# ---------------------------------------------------------------------------
+# normalizers
+# ---------------------------------------------------------------------------
+
+
+def engine_metrics(logs) -> List[TickMetrics]:
+    """Normalize stacked engine ``StepLog`` rows into TickMetrics.
+
+    Counters come from ``diff.expand_counters`` (exact python-int
+    products); gauges are read straight off the log's end-of-tick
+    snapshot fields.
+    """
+    from rapid_tpu.engine.diff import expand_counters
+
+    counters = expand_counters(logs)
+    ticks = np.asarray(logs.tick)
+    ann = np.asarray(logs.announce_now)
+    dec = np.asarray(logs.decide_now)
+    n_member = np.asarray(logs.n_member)
+    epoch = np.asarray(logs.epoch)
+    in_flight = np.asarray(logs.alerts_in_flight)
+    cut_reports = np.asarray(logs.cut_reports)
+    implicit = np.asarray(logs.implicit_reports)
+    tally = np.asarray(logs.vote_tally)
+    quorum = np.asarray(logs.quorum)
+    churned = np.asarray(logs.churn_injected)
+
+    out: List[TickMetrics] = []
+    for i, c in enumerate(counters):
+        out.append(TickMetrics(
+            tick=int(ticks[i]), source="engine", **c,
+            n_member=int(n_member[i]),
+            epoch=int(epoch[i]),
+            alerts_in_flight=int(in_flight[i]),
+            cut_reports=int(cut_reports[i]),
+            implicit_reports=int(implicit[i]),
+            vote_tally=int(tally[i]),
+            quorum=int(quorum[i]),
+            churn_injected=int(churned[i]),
+            announce=bool(ann[i]),
+            decide=bool(dec[i]),
+        ))
+    return out
+
+
+def oracle_metrics(per_tick_counters: Sequence[Dict[str, int]],
+                   events: Iterable = (),
+                   start_tick: int = 0) -> List[TickMetrics]:
+    """Normalize oracle ``NetworkCounters`` deltas into TickMetrics.
+
+    ``per_tick_counters`` is what ``diff.run_oracle`` returns (one
+    ``as_dict`` per tick, first entry covering ``start_tick + 1``);
+    ``events`` are ``ViewEvent`` records used to flag announce/decide
+    ticks. Gauges stay ``UNOBSERVED``.
+    """
+    ann_ticks = {e.tick for e in events if e.kind == "proposal"}
+    dec_ticks = {e.tick for e in events if e.kind == "view_change"}
+    out: List[TickMetrics] = []
+    for i, c in enumerate(per_tick_counters):
+        tick = start_tick + 1 + i
+        out.append(TickMetrics(
+            tick=tick, source="oracle", **c,
+            announce=tick in ann_ticks, decide=tick in dec_ticks))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JSONL round-trip
+# ---------------------------------------------------------------------------
+
+
+def write_jsonl(records: Iterable[TickMetrics], path) -> None:
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r.as_dict(), sort_keys=True) + "\n")
+
+
+def read_jsonl(path) -> List[TickMetrics]:
+    out: List[TickMetrics] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(TickMetrics.from_dict(json.loads(line)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-run summaries
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunSummary:
+    """Protocol-level summary of one simulated run (Rapid §6 observables).
+
+    ``view_changes`` carries one record per decided proposal: its
+    announce/decide ticks, ticks from the start of its window (run start
+    or the previous decide) to the decision, and the exact message traffic
+    attributable to that window.
+    """
+
+    source: str
+    n_ticks: int
+    announcements: int
+    decisions: int
+    ticks_to_first_announce: Optional[int]
+    ticks_to_first_decide: Optional[int]
+    messages_per_view_change: Optional[float]
+    view_changes: List[Dict[str, object]]
+    total_sent: int
+    total_delivered: int
+    total_dropped: int
+    total_timeouts: int
+    total_probes_sent: int
+    total_probes_failed: int
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
+    """Fold a TickMetrics stream into a RunSummary."""
+    start_tick = metrics[0].tick - 1 if metrics else 0
+    first_announce: Optional[int] = None
+    first_decide: Optional[int] = None
+    announcements = 0
+    decisions = 0
+    view_changes: List[Dict[str, object]] = []
+    window_start = start_tick
+    window_announce: Optional[int] = None
+    window_sent = 0
+    window_delivered = 0
+    totals = dict.fromkeys(COUNTER_FIELDS, 0)
+
+    for m in metrics:
+        for f in COUNTER_FIELDS:
+            totals[f] += getattr(m, f)
+        window_sent += m.sent
+        window_delivered += m.delivered
+        if m.announce:
+            announcements += 1
+            window_announce = m.tick
+            if first_announce is None:
+                first_announce = m.tick
+        if m.decide:
+            decisions += 1
+            if first_decide is None:
+                first_decide = m.tick
+            view_changes.append({
+                "announce_tick": window_announce,
+                "decide_tick": m.tick,
+                "ticks_to_decide": m.tick - window_start,
+                "messages_sent": window_sent,
+                "messages_delivered": window_delivered,
+            })
+            window_start = m.tick
+            window_announce = None
+            window_sent = 0
+            window_delivered = 0
+
+    per_vc = (sum(v["messages_sent"] for v in view_changes)
+              / len(view_changes)) if view_changes else None
+    return RunSummary(
+        source=metrics[0].source if metrics else "empty",
+        n_ticks=len(metrics),
+        announcements=announcements,
+        decisions=decisions,
+        ticks_to_first_announce=(first_announce - start_tick
+                                 if first_announce is not None else None),
+        ticks_to_first_decide=(first_decide - start_tick
+                               if first_decide is not None else None),
+        messages_per_view_change=per_vc,
+        view_changes=view_changes,
+        total_sent=totals["sent"],
+        total_delivered=totals["delivered"],
+        total_dropped=totals["dropped"],
+        total_timeouts=totals["timeouts"],
+        total_probes_sent=totals["probes_sent"],
+        total_probes_failed=totals["probes_failed"],
+    )

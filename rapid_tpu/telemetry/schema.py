@@ -1,0 +1,135 @@
+"""Summary-schema validation for BENCH payloads (no external deps).
+
+The tier-1 smoke step (``scripts/tier1.sh``) runs the root ``bench.py``
+shim and validates its JSON against this schema:
+
+    python -m rapid_tpu.telemetry.schema /path/to/bench.json
+
+Exit code 0 means the payload carries well-typed per-run telemetry
+blocks (``rapid_tpu.telemetry.metrics.RunSummary.as_dict``); a non-zero
+exit prints one line per violation. Validation is structural typing by
+hand — the container image has no jsonschema, and the schema is small
+enough that a field->type table is clearer anyway.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+_NUM = (int, float)
+_OPT_INT = (int, type(None))
+
+#: RunSummary.as_dict() — the per-run "telemetry" block.
+TELEMETRY_SPEC = {
+    "source": (str,),
+    "n_ticks": (int,),
+    "announcements": (int,),
+    "decisions": (int,),
+    "ticks_to_first_announce": _OPT_INT,
+    "ticks_to_first_decide": _OPT_INT,
+    "messages_per_view_change": (int, float, type(None)),
+    "view_changes": (list,),
+    "total_sent": (int,),
+    "total_delivered": (int,),
+    "total_dropped": (int,),
+    "total_timeouts": (int,),
+    "total_probes_sent": (int,),
+    "total_probes_failed": (int,),
+}
+
+VIEW_CHANGE_SPEC = {
+    "announce_tick": _OPT_INT,
+    "decide_tick": (int,),
+    "ticks_to_decide": (int,),
+    "messages_sent": (int,),
+    "messages_delivered": (int,),
+}
+
+#: Required fields of one bench_engine run payload.
+RUN_SPEC = {
+    "bench": (str,),
+    "n": (int,),
+    "ticks": (int,),
+    "wall_s": _NUM,
+    "ticks_per_sec": _NUM,
+    "rounds_per_sec": _NUM,
+    "telemetry": (dict,),
+}
+
+
+def _check(obj: Dict, spec: Dict, where: str) -> List[str]:
+    errors = []
+    if not isinstance(obj, dict):
+        return [f"{where}: expected an object, got {type(obj).__name__}"]
+    for key, types in spec.items():
+        if key not in obj:
+            errors.append(f"{where}.{key}: missing")
+        elif not isinstance(obj[key], types) or (
+                isinstance(obj[key], bool) and bool not in types):
+            errors.append(
+                f"{where}.{key}: expected "
+                f"{'/'.join(t.__name__ for t in types)}, "
+                f"got {type(obj[key]).__name__}")
+    return errors
+
+
+def validate_telemetry(block, where: str = "telemetry") -> List[str]:
+    errors = _check(block, TELEMETRY_SPEC, where)
+    if isinstance(block, dict):
+        for i, vc in enumerate(block.get("view_changes") or []):
+            errors += _check(vc, VIEW_CHANGE_SPEC,
+                             f"{where}.view_changes[{i}]")
+    return errors
+
+
+def validate_run_payload(payload, where: str = "payload") -> List[str]:
+    errors = _check(payload, RUN_SPEC, where)
+    if isinstance(payload, dict) and isinstance(payload.get("telemetry"),
+                                                dict):
+        errors += validate_telemetry(payload["telemetry"],
+                                     f"{where}.telemetry")
+    return errors
+
+
+def validate_bench_payload(payload) -> List[str]:
+    """Validate a single-run, sweep, or suite (root ``bench.py``) payload."""
+    if not isinstance(payload, dict):
+        return ["payload: expected a JSON object"]
+    if payload.get("bench") == "engine_tick_suite":
+        errors = []
+        for key in ("steady", "churn"):
+            if key not in payload:
+                errors.append(f"payload.{key}: missing")
+            else:
+                errors += validate_run_payload(payload[key],
+                                               f"payload.{key}")
+        return errors
+    if "sweep" in payload:
+        errors = []
+        for i, run in enumerate(payload["sweep"]):
+            errors += validate_run_payload(run, f"payload.sweep[{i}]")
+        return errors
+    return validate_run_payload(payload)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m rapid_tpu.telemetry.schema BENCH_JSON",
+              file=sys.stderr)
+        return 2
+    with open(argv[0]) as fh:
+        payload = json.load(fh)
+    errors = validate_bench_payload(payload)
+    if errors:
+        for e in errors:
+            print(f"schema violation: {e}", file=sys.stderr)
+        return 1
+    kind = payload.get("bench", "?")
+    print(f"telemetry schema ok: {argv[0]} ({kind})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

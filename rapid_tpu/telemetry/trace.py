@@ -1,0 +1,235 @@
+"""Chrome/Perfetto trace-event export for simulation runs.
+
+Produces the JSON trace-event format (``{"traceEvents": [...]}``) that
+both ``ui.perfetto.dev`` and the legacy ``chrome://tracing`` load
+directly. Two process tracks:
+
+- **pid 1, virtual time**: the simulation rendered on the virtual-time
+  axis (1 tick = ``Settings.tick_ms`` of trace time). Each tick is cut
+  into five sub-slices in the engine's canonical intra-tick phase order
+  — decide / deliver / flush / churn / monitor — emitted as matched B/E
+  pairs only when the phase did work; instant events mark proposal
+  announcements, view-change decisions, and churn activations; counter
+  tracks plot membership size, alert-pipeline occupancy, and
+  cut-detector fill per tick.
+- **pid 2, host wall-clock**: real-time spans recorded by the
+  ``wall_span`` context manager (jit trace+compile, device dispatch,
+  ``plan_churn``, host-side topology build). These live on a separate
+  process so the microsecond axes never mix; Perfetto shows both tracks
+  and the compile-vs-dispatch split is visible at a glance.
+
+``jax_profiler_trace`` optionally wraps a region in ``jax.profiler``'s
+own tracer for XLA-level detail alongside this writer's spans.
+"""
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import numpy as np
+
+VIRTUAL_PID = 1
+WALL_PID = 2
+TID_PHASES = 1
+TID_EVENTS = 2
+TID_WALL = 1
+
+#: Intra-tick phase order, matching ``rapid_tpu.engine.step``.
+PHASES = ("decide", "deliver", "flush", "churn", "monitor")
+
+
+class TraceWriter:
+    """Accumulates trace events; ``write`` emits Perfetto-loadable JSON."""
+
+    def __init__(self) -> None:
+        self._events: List[Dict[str, object]] = []
+        self._wall_t0 = time.perf_counter()
+        self._meta_done: set = set()
+
+    # -- wall clock ------------------------------------------------------
+
+    def wall_now_us(self) -> int:
+        """Microseconds since this writer was created (wall-clock axis)."""
+        return int((time.perf_counter() - self._wall_t0) * 1e6)
+
+    # -- metadata --------------------------------------------------------
+
+    def meta_process(self, pid: int, name: str) -> None:
+        key = ("process", pid)
+        if key in self._meta_done:
+            return
+        self._meta_done.add(key)
+        self._events.append({"ph": "M", "name": "process_name", "pid": pid,
+                             "tid": 0, "ts": 0, "args": {"name": name}})
+
+    def meta_thread(self, pid: int, tid: int, name: str) -> None:
+        key = ("thread", pid, tid)
+        if key in self._meta_done:
+            return
+        self._meta_done.add(key)
+        self._events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                             "tid": tid, "ts": 0, "args": {"name": name}})
+
+    # -- events ----------------------------------------------------------
+
+    def slice(self, name: str, ts_us: int, dur_us: int, pid: int, tid: int,
+              args: Optional[Dict[str, object]] = None) -> None:
+        """A matched B/E pair (duration slice)."""
+        begin = {"ph": "B", "name": name, "pid": pid, "tid": tid,
+                 "ts": int(ts_us)}
+        if args:
+            begin["args"] = args
+        self._events.append(begin)
+        self._events.append({"ph": "E", "pid": pid, "tid": tid,
+                             "ts": int(ts_us) + max(1, int(dur_us))})
+
+    def instant(self, name: str, ts_us: int, pid: int, tid: int,
+                args: Optional[Dict[str, object]] = None) -> None:
+        ev = {"ph": "i", "name": name, "pid": pid, "tid": tid,
+              "ts": int(ts_us), "s": "t"}
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    def counter(self, name: str, ts_us: int, pid: int,
+                values: Dict[str, int]) -> None:
+        self._events.append({"ph": "C", "name": name, "pid": pid, "tid": 0,
+                             "ts": int(ts_us), "args": values})
+
+    # -- output ----------------------------------------------------------
+
+    def sorted_events(self) -> List[Dict[str, object]]:
+        """Events sorted by timestamp, emission order breaking ties (so
+        same-ts outer B slices stay ahead of their nested children)."""
+        return sorted(self._events, key=lambda e: e["ts"])
+
+    def to_json(self) -> Dict[str, object]:
+        return {"traceEvents": self.sorted_events(),
+                "displayTimeUnit": "ms"}
+
+    def write(self, path) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh)
+            fh.write("\n")
+
+
+@contextmanager
+def wall_span(writer: Optional[TraceWriter], name: str,
+              args: Optional[Dict[str, object]] = None):
+    """Time a host-side region onto the wall-clock track.
+
+    No-op when ``writer`` is None, so instrumented call sites cost
+    nothing un-traced.
+    """
+    if writer is None:
+        yield
+        return
+    writer.meta_process(WALL_PID, "host wall-clock")
+    writer.meta_thread(WALL_PID, TID_WALL, "host")
+    t0 = writer.wall_now_us()
+    try:
+        yield
+    finally:
+        writer.slice(name, t0, writer.wall_now_us() - t0,
+                     WALL_PID, TID_WALL, args)
+
+
+@contextmanager
+def jax_profiler_trace(log_dir: Optional[str]):
+    """Wrap a region in ``jax.profiler.trace`` when a directory is given.
+
+    The profiler writes its own TensorBoard/XPlane artifacts next to (not
+    inside) this module's trace JSON; pass None to disable.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def trace_from_logs(logs, settings, writer: Optional[TraceWriter] = None,
+                    pid: int = VIRTUAL_PID) -> TraceWriter:
+    """Render stacked engine ``StepLog`` rows onto the virtual-time axis.
+
+    One tick spans ``settings.tick_ms`` milliseconds of trace time, cut
+    into five equal phase sub-slices; a phase is emitted only when it did
+    observable work that tick, so quiescent stretches stay empty.
+    """
+    writer = writer or TraceWriter()
+    us_per_tick = settings.tick_ms * 1000
+    sub = us_per_tick // len(PHASES)
+
+    ticks = np.asarray(logs.tick)
+    ann = np.asarray(logs.announce_now)
+    dec = np.asarray(logs.decide_now)
+    proposal = np.asarray(logs.proposal)
+    decision = np.asarray(logs.decision)
+    n_member = np.asarray(logs.n_member)
+    epoch = np.asarray(logs.epoch)
+    flushers = np.asarray(logs.flushers)
+    deliver_alive = np.asarray(logs.deliver_alive)
+    probes_sent = np.asarray(logs.probes_sent)
+    probes_failed = np.asarray(logs.probes_failed)
+    in_flight = np.asarray(logs.alerts_in_flight)
+    cut_reports = np.asarray(logs.cut_reports)
+    implicit = np.asarray(logs.implicit_reports)
+    tally = np.asarray(logs.vote_tally)
+    quorum = np.asarray(logs.quorum)
+    churned = np.asarray(logs.churn_injected)
+    cfg_hi = np.asarray(logs.config_hi).astype(np.uint64)
+    cfg_lo = np.asarray(logs.config_lo).astype(np.uint64)
+    cfg = (cfg_hi << np.uint64(32)) | cfg_lo
+
+    writer.meta_process(pid, "rapid-tpu virtual time")
+    writer.meta_thread(pid, TID_PHASES, "tick phases")
+    writer.meta_thread(pid, TID_EVENTS, "protocol events")
+
+    for i in range(len(ticks)):
+        t = int(ticks[i])
+        base = t * us_per_tick
+        phase_work = {
+            "decide": bool(dec[i]) or int(tally[i]) > 0,
+            "deliver": int(deliver_alive[i]) > 0 or bool(ann[i]),
+            "flush": int(flushers[i]) > 0,
+            "churn": int(churned[i]) > 0,
+            "monitor": int(probes_sent[i]) > 0,
+        }
+        phase_args = {
+            "decide": {"vote_tally": int(tally[i]),
+                       "quorum": int(quorum[i]),
+                       "epoch": int(epoch[i])},
+            "deliver": {"cut_reports": int(cut_reports[i]),
+                        "implicit_reports": int(implicit[i])},
+            "flush": {"flushers": int(flushers[i])},
+            "churn": {"alerts_enqueued": int(churned[i])},
+            "monitor": {"probes_sent": int(probes_sent[i]),
+                        "probes_failed": int(probes_failed[i])},
+        }
+        for j, phase in enumerate(PHASES):
+            if phase_work[phase]:
+                writer.slice(phase, base + j * sub, sub, pid, TID_PHASES,
+                             phase_args[phase])
+        if ann[i]:
+            writer.instant("proposal", base + sub + sub // 2, pid,
+                           TID_EVENTS,
+                           {"tick": t, "slots": int(proposal[i].sum())})
+        if dec[i]:
+            writer.instant("view_change", base + sub // 2, pid, TID_EVENTS,
+                           {"tick": t, "slots": int(decision[i].sum()),
+                            "n_member": int(n_member[i]),
+                            "config_id": f"{int(cfg[i]):#x}"})
+        if churned[i]:
+            writer.instant("churn_activation", base + 3 * sub + sub // 2,
+                           pid, TID_EVENTS,
+                           {"tick": t, "slots": int(churned[i])})
+        writer.counter("membership", base, pid, {"n": int(n_member[i])})
+        writer.counter("alerts_in_flight", base, pid,
+                       {"batches": int(in_flight[i])})
+        writer.counter("cut_reports", base, pid,
+                       {"cells": int(cut_reports[i])})
+    return writer

@@ -16,4 +16,18 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# Telemetry smoke: the root bench shim must emit a schema-valid payload
+# (CPU-only, small N so it stays cheap). Only meaningful when the test
+# suite itself passed.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py \
+            --n 256 --ticks 8 --out /tmp/_t1_bench.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_bench.json; then
+        echo BENCH_SMOKE=ok
+    else
+        echo BENCH_SMOKE=failed
+        rc=1
+    fi
+fi
 exit $rc

@@ -13,6 +13,7 @@ from rapid_tpu.engine import (
     init_state,
     simulate,
     state_config_id,
+    reset_trace_count,
     trace_count,
 )
 from rapid_tpu.engine.state import I32_MAX, crash_faults
@@ -163,14 +164,17 @@ def test_engine_step_smoke_n64_single_trace():
 
     # A distinct (but behaviorally identical) Settings instance guarantees a
     # fresh jit cache entry, so the trace count below is deterministic even
-    # if other tests already compiled the step at this shape.
+    # if other tests already compiled the step at this shape; the reset
+    # makes the counter itself independent of test execution order.
     settings = replace(SETTINGS, seed=1234)
     endpoints, _, view = make_members(64)
     uids = [uid_of(e) for e in endpoints]
     state = init_state(uids, view._id_fp_sum, settings)
     faults = crash_faults([I32_MAX] * 64)
 
+    reset_trace_count()
     before = trace_count()
+    assert before == 0
     state1, log1 = engine_step(state, faults, settings)
     first_trace = trace_count() - before
     assert first_trace == 1, "first call should trace the step body once"

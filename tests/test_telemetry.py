@@ -1,0 +1,296 @@
+"""Telemetry layer tests: unified metrics, trace export, forensics.
+
+One real differential run (N=64 crash burst, module-scoped) feeds the
+metric-parity and summary assertions; the forensics tests perturb a
+deep copy of that run to prove a deliberately-divergent engine produces
+a first-divergence report naming tick and field. Trace-export validity
+is checked structurally: timestamps sorted, B/E pairs matched per
+(pid, tid), instants on the decision tick.
+"""
+import copy
+import json
+
+import pytest
+
+from rapid_tpu.engine.diff import (
+    ChurnDiffResult,
+    ViewEvent,
+    read_events_jsonl,
+    run_differential,
+    write_events_jsonl,
+)
+from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry import (
+    COUNTER_FIELDS,
+    UNOBSERVED,
+    DivergenceError,
+    TickMetrics,
+    counters_equal,
+    read_jsonl,
+    summarize,
+    write_jsonl,
+)
+from rapid_tpu.telemetry import schema as tschema
+
+SETTINGS = Settings()
+
+
+@pytest.fixture(scope="module")
+def diff_result():
+    """One N=64 crash-burst differential shared by the module's tests."""
+    return run_differential(64, {3: 5, 17: 5}, 130)
+
+
+# ---------------------------------------------------------------------------
+# unified TickMetrics
+# ---------------------------------------------------------------------------
+
+
+def test_engine_and_oracle_metrics_agree(diff_result):
+    eng = diff_result.engine_metrics
+    orc = diff_result.oracle_metrics
+    assert len(eng) == len(orc) == 130
+    for e, o in zip(eng, orc):
+        assert e.source == "engine" and o.source == "oracle"
+        assert counters_equal(e, o), (e, o)
+        # announce/decide flags are protocol-visible on both sides
+        assert (e.announce, e.decide) == (o.announce, o.decide)
+        # gauges are engine-side observables only
+        assert o.n_member == UNOBSERVED and o.vote_tally == UNOBSERVED
+        assert e.n_member in (62, 64)
+
+
+def test_engine_gauges_traverse_protocol_phases(diff_result):
+    eng = diff_result.engine_metrics
+    # the crash burst must fill the cut detector and inject alerts
+    assert max(m.cut_reports for m in eng) > 0
+    assert max(m.alerts_in_flight for m in eng) > 0
+    # the decision tick carries a quorum-meeting tally and shrinks the view
+    decide = [m for m in eng if m.decide]
+    assert len(decide) == 1
+    m = decide[0]
+    assert m.quorum == 49  # fast_quorum(64) = 64 - 63 // 4
+    assert m.vote_tally >= m.quorum
+    assert m.epoch == 1
+    after = [x for x in eng if x.tick > m.tick]
+    assert all(x.n_member == 62 for x in after)
+
+
+def test_tick_metrics_jsonl_round_trip(tmp_path, diff_result):
+    path = tmp_path / "metrics.jsonl"
+    write_jsonl(diff_result.engine_metrics, path)
+    back = read_jsonl(path)
+    assert back == diff_result.engine_metrics
+    # every line is standalone JSON with the full field set
+    with open(path) as fh:
+        first = json.loads(fh.readline())
+    assert set(first) == set(TickMetrics(0, "engine").as_dict())
+
+
+def test_run_summary(diff_result):
+    s = summarize(diff_result.engine_metrics)
+    assert s.source == "engine"
+    assert s.n_ticks == 130
+    assert s.announcements == 1 and s.decisions == 1
+    assert s.ticks_to_first_announce == 112
+    assert s.ticks_to_first_decide == 113
+    assert len(s.view_changes) == 1
+    vc = s.view_changes[0]
+    assert vc["announce_tick"] == 112 and vc["decide_tick"] == 113
+    assert vc["messages_sent"] > 0
+    assert s.messages_per_view_change == vc["messages_sent"]
+    assert s.total_sent >= s.total_delivered
+    # oracle stream folds to the same protocol summary
+    o = summarize(diff_result.oracle_metrics)
+    assert (o.decisions, o.ticks_to_first_decide, o.total_sent) == \
+        (s.decisions, s.ticks_to_first_decide, s.total_sent)
+
+
+def test_view_event_jsonl_round_trip(tmp_path, diff_result):
+    path = tmp_path / "events.jsonl"
+    write_events_jsonl(diff_result.engine_events, path)
+    assert read_events_jsonl(path) == diff_result.engine_events
+
+
+# ---------------------------------------------------------------------------
+# divergence forensics
+# ---------------------------------------------------------------------------
+
+
+def test_clean_run_has_no_divergence(diff_result):
+    assert diff_result.first_divergence() is None
+    diff_result.assert_identical()  # must not raise
+
+
+def test_perturbed_counters_name_tick_and_field(tmp_path, diff_result):
+    bad = copy.deepcopy(diff_result)
+    bad.engine_counters[50]["sent"] += 16
+    artifact = tmp_path / "div.jsonl"
+    with pytest.raises(DivergenceError) as exc:
+        bad.assert_identical(artifact=str(artifact))
+    report = exc.value.report
+    assert report.tick == 51
+    assert report.field == "counters.sent"
+    assert report.engine == 16 and report.oracle == 0
+    assert "tick 51" in str(exc.value)
+    assert report.context, "report must carry trailing context records"
+    # artifact: context records first, the divergence record last
+    lines = [json.loads(line) for line in
+             artifact.read_text().splitlines()]
+    assert lines[-1]["record"] == "divergence"
+    assert lines[-1]["field"] == "counters.sent"
+    assert all(rec["record"] == "tick_metrics" for rec in lines[:-1])
+
+
+def test_perturbed_events_report_earliest_field(diff_result):
+    bad = copy.deepcopy(diff_result)
+    bad.engine_events[0] = ViewEvent(
+        tick=bad.engine_events[0].tick, kind="view_change",
+        config_id=bad.engine_events[0].config_id,
+        slots=bad.engine_events[0].slots)
+    with pytest.raises(DivergenceError) as exc:
+        bad.assert_identical()
+    assert exc.value.report.field == "events[0].kind"
+    assert exc.value.report.tick == 112
+
+    bad = copy.deepcopy(diff_result)
+    del bad.engine_events[1]
+    with pytest.raises(DivergenceError) as exc:
+        bad.assert_identical()
+    assert exc.value.report.field == "events.length"
+    assert exc.value.report.tick == 113
+
+
+def test_churn_plan_divergence_is_attributed():
+    # Fabricated triangle: the planner's stream disagrees with the oracle
+    # while the engine matches — forensics must blame the plan_* side.
+    ev = [ViewEvent(20, "proposal", 7, (64,)),
+          ViewEvent(21, "view_change", 9, (64,))]
+    plan = [ev[0], ViewEvent(21, "view_change", 10, (64,))]
+    res = ChurnDiffResult(
+        n_initial=4, capacity=5, n_ticks=40,
+        oracle_events=ev, engine_events=list(ev), plan_events=plan,
+        oracle_config_id=9, engine_config_id=9, plan_config_id=10,
+        oracle_members=frozenset({0, 1, 2, 3, 4}),
+        engine_members=frozenset({0, 1, 2, 3, 4}),
+        plan_members=frozenset({0, 1, 2, 3, 4}))
+    with pytest.raises(DivergenceError) as exc:
+        res.assert_identical()
+    assert exc.value.report.field == "plan_events[1].config_id"
+    assert exc.value.report.tick == 21
+
+
+# ---------------------------------------------------------------------------
+# trace export
+# ---------------------------------------------------------------------------
+
+
+def _paired_b_e(events):
+    stacks = {}
+    for e in events:
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e)
+        elif e["ph"] == "E":
+            if not stacks.get(key):
+                return False
+            stacks[key].pop()
+    return all(not s for s in stacks.values())
+
+
+def test_trace_export_structure(tmp_path):
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.step import simulate
+    from rapid_tpu.oracle.membership_view import uid_of
+    from rapid_tpu.telemetry.trace import (
+        VIRTUAL_PID,
+        WALL_PID,
+        TraceWriter,
+        trace_from_logs,
+        wall_span,
+    )
+    from rapid_tpu.types import Endpoint
+
+    n = 16
+    uids = [uid_of(Endpoint(f"n{i}.sim", 5000)) for i in range(n)]
+    state = init_state(uids, id_fp_sum=0, settings=SETTINGS)
+    crash = [I32_MAX] * n
+    crash[2] = 3
+    writer = TraceWriter()
+    with wall_span(writer, "device_dispatch", {"ticks": 130}):
+        _, logs = simulate(state, crash_faults(crash), 130, SETTINGS)
+    trace_from_logs(logs, SETTINGS, writer=writer)
+
+    path = tmp_path / "trace.json"
+    writer.write(path)
+    payload = json.loads(path.read_text())
+    events = payload["traceEvents"]
+
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    assert _paired_b_e(events)
+
+    walls = [e for e in events
+             if e["pid"] == WALL_PID and e["ph"] == "B"]
+    assert [e["name"] for e in walls] == ["device_dispatch"]
+
+    instants = [e for e in events if e["ph"] == "i"]
+    assert all(e["pid"] == VIRTUAL_PID for e in instants)
+    by_name = {e["name"]: e for e in instants}
+    assert set(by_name) == {"proposal", "view_change"}
+    # the view-change instant lands inside its decision tick's window
+    us_per_tick = SETTINGS.tick_ms * 1000
+    decide_tick = by_name["view_change"]["args"]["tick"]
+    assert decide_tick * us_per_tick <= by_name["view_change"]["ts"] \
+        < (decide_tick + 1) * us_per_tick
+    assert by_name["view_change"]["args"]["config_id"].startswith("0x")
+
+    slices = {e["name"] for e in events
+              if e["pid"] == VIRTUAL_PID and e["ph"] == "B"}
+    assert {"deliver", "flush", "monitor"} <= slices
+    counters = {e["name"] for e in events if e["ph"] == "C"}
+    assert counters == {"membership", "alerts_in_flight", "cut_reports"}
+
+
+# ---------------------------------------------------------------------------
+# bench payload schema (the tier-1 smoke contract)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_run_payload_passes_schema():
+    import os
+    import sys
+
+    # benchmarks/ is a repo-root namespace package, not installed
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from benchmarks.bench_engine import run
+
+    payload = run(64, 20, crash_frac=0.02, crash_tick=5,
+                  settings=SETTINGS)
+    assert tschema.validate_bench_payload(payload) == []
+    assert payload["telemetry"]["source"] == "engine"
+    assert payload["telemetry"]["n_ticks"] == 20
+    assert "ticks_to_first_decide" in payload
+    assert "messages_per_view_change" in payload
+
+
+def test_schema_rejects_malformed_payload():
+    good = {
+        "bench": "engine_tick", "n": 64, "ticks": 20, "wall_s": 0.1,
+        "ticks_per_sec": 200.0, "rounds_per_sec": 40.0,
+        "telemetry": summarize([]).as_dict(),
+    }
+    assert tschema.validate_bench_payload(good) == []
+    bad = dict(good)
+    bad.pop("telemetry")
+    assert any("telemetry" in e for e in
+               tschema.validate_bench_payload(bad))
+    bad = dict(good)
+    bad["telemetry"] = dict(good["telemetry"], decisions="three")
+    assert any("decisions" in e for e in
+               tschema.validate_bench_payload(bad))
+    suite = {"bench": "engine_tick_suite", "steady": good}
+    assert any("churn" in e for e in
+               tschema.validate_bench_payload(suite))

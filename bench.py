@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""Repo-root benchmark shim: one small steady + churn suite, JSON out.
+"""Repo-root benchmark shim: steady + churn + contested suite, JSON out.
 
 This is the harness entry point (``python bench.py``): it runs the
-engine tick benchmark twice — an N=1k steady crash-burst and an N=1k
-sustained-churn run — with defaults small enough to finish quickly on
-CPU, and emits a single ``engine_tick_suite`` JSON payload (with
-trailing newline) on stdout or to ``--out FILE``. Each sub-payload
+engine tick benchmark three times — an N=1k steady crash-burst, an N=1k
+sustained-churn run, and an N=1k contested-consensus run through the
+classic-Paxos fallback kernel — with defaults small enough to finish
+quickly on CPU, and emits a single ``engine_tick_suite`` JSON payload.
+When writing to stdout the payload is one compact line (the *last*
+line, so harnesses that parse the stdout tail always get the whole
+object); ``--out FILE`` writes the indented form. Each sub-payload
 carries the per-run protocol summary in its ``telemetry`` block
 (``rapid_tpu.telemetry.metrics.RunSummary``), validatable with::
 
@@ -25,7 +28,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from benchmarks.bench_engine import run, run_churn  # noqa: E402
+from benchmarks.bench_engine import run, run_churn, run_contested  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -54,13 +57,13 @@ def main(argv=None) -> int:
                       settings=settings, seed=args.seed),
         "churn": run_churn(args.n, args.ticks, args.burst, settings,
                            args.seed),
+        "contested": run_contested(args.n, args.ticks, settings, args.seed),
     }
-    text = json.dumps(payload, indent=2) + "\n"
     if args.out:
         with open(args.out, "w") as fh:
-            fh.write(text)
+            fh.write(json.dumps(payload, indent=2) + "\n")
     else:
-        sys.stdout.write(text)
+        sys.stdout.write(json.dumps(payload) + "\n")
     return 0
 
 

@@ -8,6 +8,10 @@ Two scenarios, selected with ``--scenario``:
 - ``churn``: sustained membership churn via
   ``rapid_tpu.engine.churn.synthetic_churn_schedule`` — alternating
   join/leave bursts reconfigure the view inside the same scan.
+- ``contested``: repeated split-vote consensus instances via
+  ``rapid_tpu.engine.paxos.synthetic_contested_schedule`` — the fast
+  round misses quorum every time and the classic-Paxos fallback kernel
+  decides each view change.
 
 One *gossip round* is one failure-detector interval — the period in
 which every node probes each unique subject once — i.e.
@@ -189,6 +193,72 @@ def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0,
     }
 
 
+def run_contested(n: int, ticks: int, settings, seed: int = 0,
+                  trace_writer=None) -> dict:
+    """Contested consensus: every scripted instance splits the members
+    into two camps below the fast quorum, so the classic-Paxos fallback
+    kernel (``rapid_tpu.engine.paxos``) decides each view change."""
+    import jax
+
+    from rapid_tpu.engine.paxos import synthetic_contested_schedule
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.step import simulate
+    from rapid_tpu.telemetry.trace import trace_from_logs, wall_span
+
+    uids = synthetic_uids(n, seed)
+    with wall_span(trace_writer, "plan_fallback", {"n": n}):
+        schedule, info = synthetic_contested_schedule(
+            n, settings, ticks, uids=uids)
+
+    boot_start = time.perf_counter()
+    with wall_span(trace_writer, "init_state+topology", {"n": n}):
+        state = init_state(uids, id_fp_sum=0, settings=settings)
+        jax.block_until_ready(state)
+    boot_s = time.perf_counter() - boot_start
+
+    faults = crash_faults([I32_MAX] * n)
+
+    compile_start = time.perf_counter()
+    with wall_span(trace_writer, "jit_trace+compile", {"ticks": ticks}):
+        final, logs = simulate(state, faults, ticks, settings,
+                               fallback=schedule)
+        jax.block_until_ready((final, logs))
+    compile_s = time.perf_counter() - compile_start
+
+    run_start = time.perf_counter()
+    with wall_span(trace_writer, "device_dispatch", {"ticks": ticks}):
+        final, logs = simulate(state, faults, ticks, settings,
+                               fallback=schedule)
+        jax.block_until_ready((final, logs))
+    wall_s = time.perf_counter() - run_start
+
+    if trace_writer is not None:
+        trace_from_logs(logs, settings, writer=trace_writer)
+
+    telemetry = _telemetry_block(logs)
+    decisions = int(np.asarray(logs.decide_now).sum())
+    ticks_per_sec = ticks / wall_s
+    return {
+        "bench": "engine_tick",
+        "scenario": "contested",
+        "platform": jax.default_backend(),
+        "n": n,
+        "k": settings.K,
+        "ticks": ticks,
+        "contested_instances": info["instances"],
+        "boot_s": round(boot_s, 4),
+        "compile_s": round(compile_s, 4),
+        "wall_s": round(wall_s, 4),
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "rounds_per_sec": round(ticks_per_sec / settings.fd_interval_ticks, 2),
+        "decisions": decisions,
+        "final_members": int(np.asarray(final.member).sum()),
+        "ticks_to_first_decide": telemetry["ticks_to_first_decide"],
+        "messages_per_view_change": telemetry["messages_per_view_change"],
+        "telemetry": telemetry,
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--n", type=int, default=10_000,
@@ -200,10 +270,12 @@ def main(argv=None) -> int:
                         help="fraction of nodes crashing (default 1%%)")
     parser.add_argument("--crash-tick", type=int, default=5,
                         help="tick of the correlated crash burst")
-    parser.add_argument("--scenario", choices=("steady", "churn"),
+    parser.add_argument("--scenario",
+                        choices=("steady", "churn", "contested"),
                         default="steady",
-                        help="steady crash-burst or sustained join/leave "
-                             "churn (default steady)")
+                        help="steady crash-burst, sustained join/leave "
+                             "churn, or contested consensus through the "
+                             "classic-Paxos fallback (default steady)")
     parser.add_argument("--burst", type=int, default=8,
                         help="churn scenario: slots per join/leave burst")
     parser.add_argument("--seed", type=int, default=0,
@@ -236,6 +308,10 @@ def main(argv=None) -> int:
             results = [run_churn(n, args.ticks, args.burst, settings,
                                  args.seed, trace_writer=writer)
                        for n in sizes]
+        elif args.scenario == "contested":
+            results = [run_contested(n, args.ticks, settings, args.seed,
+                                     trace_writer=writer)
+                       for n in sizes]
         else:
             results = [run(n, args.ticks, args.crash_frac, args.crash_tick,
                            settings, args.seed, trace_writer=writer)
@@ -245,13 +321,14 @@ def main(argv=None) -> int:
     if writer is not None:
         writer.write(args.trace)
         payload["trace"] = args.trace
-    # BENCH artifacts end with a newline (ADVICE.md round-5 nit).
-    text = json.dumps(payload, indent=2) + "\n"
+    # BENCH artifacts end with a newline (ADVICE.md round-5 nit). On
+    # stdout the payload is one compact line, so harnesses that parse the
+    # last stdout line always get the whole JSON object.
     if args.out:
         with open(args.out, "w") as fh:
-            fh.write(text)
+            fh.write(json.dumps(payload, indent=2) + "\n")
     else:
-        sys.stdout.write(text)
+        sys.stdout.write(json.dumps(payload) + "\n")
     return 0
 
 

@@ -229,6 +229,7 @@ def expand_counters(logs) -> List[Dict[str, int]]:
     vote_deliver = np.asarray(logs.vote_deliver_alive)
     probes_sent = np.asarray(logs.probes_sent)
     probes_failed = np.asarray(logs.probes_failed)
+    px = expand_fallback_counters(logs)
 
     out: List[Dict[str, int]] = []
     prev_batch_sent = 0
@@ -238,9 +239,12 @@ def expand_counters(logs) -> List[Dict[str, int]]:
         vote_sent = int(vote_send[i]) * int(vote_rcpt[i])
         batch_delivered = int(flush_alive[i]) * int(deliver_alive[i])
         vote_delivered = int(vote_alive[i]) * int(vote_deliver[i])
+        px_sent = sum(v for k, v in px[i].items() if k.endswith("_sent"))
+        px_delivered = sum(v for k, v in px[i].items()
+                           if k.endswith("_delivered"))
         out.append({
-            "sent": batch_sent + vote_sent,
-            "delivered": batch_delivered + vote_delivered,
+            "sent": batch_sent + vote_sent + px_sent,
+            "delivered": batch_delivered + vote_delivered + px_delivered,
             "dropped": (prev_batch_sent - batch_delivered)
                        + (prev_vote_sent - vote_delivered),
             "timeouts": 0,
@@ -249,6 +253,43 @@ def expand_counters(logs) -> List[Dict[str, int]]:
         })
         prev_batch_sent = batch_sent
         prev_vote_sent = vote_sent
+    return out
+
+
+#: (log field pair -> oracle phase key) for the fallback message classes.
+_PX_CLASSES = (
+    ("pxvote_senders", "pxvote_recipients", "fast_vote"),
+    ("px1a_senders", "px1a_recipients", "phase1a"),
+    ("px1b_senders", None, "phase1b"),              # unicast: 1 recipient
+    ("px2a_senders", "px2a_recipients", "phase2a"),
+    ("px2b_senders", "px2b_recipients", "phase2b"),
+)
+
+
+def expand_fallback_counters(logs) -> List[Dict[str, int]]:
+    """Per-tick per-phase consensus message counts from the StepLog factors.
+
+    Key set matches ``SimNetwork.consensus_history``. The fallback envelope
+    is crash-free, so every message sent at t-1 is delivered at t (kicked
+    nodes keep their registered server; network-level delivery counts them
+    exactly as the oracle does).
+    """
+    fields = {name: np.asarray(getattr(logs, name))
+              for s, r, _ in _PX_CLASSES
+              for name in (s, r) if name is not None}
+    n_ticks = len(np.asarray(logs.tick))
+    out: List[Dict[str, int]] = []
+    prev = {phase: 0 for _, _, phase in _PX_CLASSES}
+    for i in range(n_ticks):
+        row: Dict[str, int] = {}
+        for s_name, r_name, phase in _PX_CLASSES:
+            sent = int(fields[s_name][i])
+            if r_name is not None:
+                sent *= int(fields[r_name][i])
+            row[f"{phase}_sent"] = sent
+            row[f"{phase}_delivered"] = prev[phase]
+            prev[phase] = sent
+        out.append(row)
     return out
 
 
@@ -419,6 +460,143 @@ class ChurnDiffResult:
         report = self.first_divergence()
         if report is not None:
             _raise_divergence(report, artifact)
+
+
+@dataclass
+class FallbackDiffResult:
+    """Oracle vs engine for a scripted contested-consensus scenario.
+
+    On top of the ``DiffResult`` contract (events, total per-tick message
+    counts, final configuration id), compares the per-*phase* consensus
+    message counts — fast-round votes and classic phase 1a/1b/2a/2b — at
+    every tick: the engine's ``expand_fallback_counters`` against the
+    oracle's ``SimNetwork.consensus_history``.
+    """
+
+    n: int
+    n_ticks: int
+    plan_info: Dict[str, object]
+    oracle_events: List[ViewEvent]
+    engine_events: List[ViewEvent]
+    oracle_counters: List[Dict[str, int]]
+    engine_counters: List[Dict[str, int]]
+    oracle_phase_counters: List[Dict[str, int]]
+    engine_phase_counters: List[Dict[str, int]]
+    oracle_config_id: int
+    engine_config_id: int
+    engine_metrics: Optional[List] = None
+    oracle_metrics: Optional[List] = None
+
+    def first_divergence(self):
+        """Earliest (tick, field) disagreement across events, total
+        counters, per-phase counters and the final config id — None when
+        bit-identical."""
+        from rapid_tpu.telemetry import forensics as fz
+
+        div = fz.earliest([
+            fz.events_divergence(self.engine_events, self.oracle_events),
+            fz.counters_divergence(self.engine_counters,
+                                   self.oracle_counters),
+            fz.counters_divergence(self.engine_phase_counters,
+                                   self.oracle_phase_counters),
+            fz.scalar_divergence("config_id", self.engine_config_id,
+                                 self.oracle_config_id, tick=self.n_ticks),
+        ])
+        if div is None:
+            return None
+        return fz.build_report(div, engine_metrics=self.engine_metrics,
+                               oracle_metrics=self.oracle_metrics,
+                               events=self.oracle_events)
+
+    def assert_identical(self, artifact: Optional[str] = None) -> None:
+        """Raise ``DivergenceError`` at the first divergence; see
+        ``DiffResult.assert_identical`` for the artifact contract."""
+        report = self.first_divergence()
+        if report is not None:
+            _raise_divergence(report, artifact)
+
+
+def run_fallback_differential(
+    n: int,
+    values: Sequence[Sequence[int]],
+    votes: Dict[int, Tuple[int, int]],
+    delays: Dict[int, int],
+    n_ticks: int,
+    settings: Optional[Settings] = None,
+) -> FallbackDiffResult:
+    """Replay one contested consensus instance through oracle and engine.
+
+    ``values[p]`` lists the member slots proposal ``p`` removes;
+    ``votes[s] = (tick, pid)`` scripts slot ``s``'s ``propose`` call at
+    that tick with that value; ``delays[s]`` is its explicit fallback
+    delay in ticks (``recovery_delay_ticks`` on the oracle side, the
+    schedule's ``prop_delay`` on the engine side — one shared
+    deterministic draw instead of two RNG streams). The planner raises
+    ``FallbackEnvelopeError`` for scenarios outside the bit-identical
+    envelope before either simulation runs.
+    """
+    from rapid_tpu.engine.paxos import plan_fallback
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.state import state_config_id
+    from rapid_tpu.engine.step import simulate
+
+    settings = settings or Settings()
+    endpoints = default_endpoints(n)
+    node_ids = default_node_ids(n)
+    uids = np.asarray([uid_of(e) for e in endpoints], np.uint64)
+
+    # --- plan: validates the envelope, predicts the outcome -------------
+    sched, info = plan_fallback(n, values, votes, delays, settings,
+                                uids=uids)
+
+    # --- oracle side (crash-free: contention comes from the script) -----
+    network, clusters, recorders = boot_static_cluster(
+        settings, endpoints, node_ids)
+    # Proposals reach FastPaxos.propose sorted by the ring-0 key, exactly
+    # as _handle_batched_alerts orders a cut-detector proposal.
+    view0 = clusters[0].membership_service.view
+    ordered = [sorted((endpoints[s] for s in val), key=view0.ring0_sort_key)
+               for val in values]
+    # Registration in (tick, slot) order gives same-tick proposes the
+    # scheduler-handle order the planner and engine assume.
+    for tick, s in sorted((vt, vs) for vs, (vt, _) in votes.items()):
+        pid = votes[s][1]
+        network.at(tick, lambda svc=clusters[s].membership_service,
+                   prop=ordered[pid], d=delays[s]:
+                   svc.fast_paxos.propose(prop, recovery_delay_ticks=d))
+    oracle_counts = run_oracle(network, n_ticks)
+    oracle_phase = [dict(d) for d in network.consensus_history]
+
+    removed = set(values[int(info["winner"])]) if info["winner"] is not None \
+        and int(info["winner"]) >= 0 else set()
+    survivors = [s for s in range(n) if s not in removed]
+    events_oracle = oracle_events(recorders, survivors)
+    oracle_cfg = clusters[survivors[0]].membership_service.view \
+        .get_current_configuration_id()
+
+    # --- engine side ----------------------------------------------------
+    id_fp_sum = view0._id_fp_sum
+    state = init_state(uids, id_fp_sum, settings)
+    faults = crash_faults([I32_MAX] * n)
+    final_state, logs = simulate(state, faults, n_ticks, settings,
+                                 fallback=sched)
+
+    from rapid_tpu.telemetry import metrics as telemetry_metrics
+
+    return FallbackDiffResult(
+        n=n, n_ticks=n_ticks, plan_info=info,
+        oracle_events=events_oracle,
+        engine_events=engine_events(logs),
+        oracle_counters=oracle_counts,
+        engine_counters=expand_counters(logs),
+        oracle_phase_counters=oracle_phase,
+        engine_phase_counters=expand_fallback_counters(logs),
+        oracle_config_id=oracle_cfg,
+        engine_config_id=state_config_id(final_state),
+        engine_metrics=telemetry_metrics.engine_metrics(logs),
+        oracle_metrics=telemetry_metrics.oracle_metrics(
+            oracle_counts, events_oracle),
+    )
 
 
 def run_churn_differential(

@@ -1,0 +1,612 @@
+"""Classic-Paxos fallback kernel: batched consensus recovery.
+
+When conflicting proposals split the fast round below its quorum
+``N - floor((N-1)/4)``, the oracle (``rapid_tpu.oracle.paxos``) recovers
+with single-decree classic Paxos: every proposer arms a jittered fallback
+timer at ``propose`` time, the first timer to fire starts phase 1a with
+rank ``(2, classic_rank_node_index)``, acceptors promise (1b, unicast to
+the coordinator), the coordinator picks a value with the Fast Paxos
+coordinator rule (Lamport tr-2005-112 Fig. 2) once a majority of promises
+arrived, and phase 2a/2b drive the decision at a ``> N/2`` accept count.
+This module is the batched engine port of that machinery over the
+``[capacity]`` slot universe:
+
+- rank state (``rnd``/``vrnd``/``crnd``) as per-slot ``(round, node_index)``
+  int32 pairs, with ``classic_rank_index`` computed from the same 64-bit
+  identity hash as the oracle's ``classic_rank_node_index`` so classic
+  ranks order identically above the fast round's ``(1, 1)``;
+- per-slot fallback timers (``px_timer``) armed at scripted ``propose``
+  ticks and cancelled by any decision (the oracle's
+  ``_on_decided_wrapped`` scheduler cancel);
+- values as small integer proposal ids (*pids*) into a static per-instance
+  proposal table, fingerprinted with ``votes.proposal_fingerprint`` so the
+  fast-round tally reuses ``votes.segmented_vote_count`` unchanged;
+- the coordinator rule as masked segmented reductions over the ring-0
+  arrival order of phase-1b messages (``coordinator_rule_pid``);
+- phase-1a/1b/2a/2b message generation and counting through the same
+  send-tick/deliver-next-tick pipeline as alert batches, logged as
+  per-tick sender/recipient factors in ``StepLog``.
+
+Scenario envelope
+-----------------
+The scripted contested instances (``FallbackSchedule``) reproduce the
+oracle bit-for-bit (``engine.diff.run_fallback_differential`` asserts it)
+under the conditions ``plan_fallback`` checks per scenario:
+
+- crash-free runs with a quiet alert path (no cut-detector proposals
+  while a scripted instance is live) — conflicting proposals come from
+  the script, standing in for the asymmetric alert delivery that the
+  shared-detector engine cannot itself produce (see ROADMAP per-node
+  detector state);
+- one classic round per instance: exactly one effective timer fire, all
+  other timers landing at/after the decide tick (where the oracle
+  cancels them), and no fast-round votes delivered mid-round — multi-
+  coordinator rank races stay host-side (``tests/test_paxos.py``);
+- in the fast/classic race, a timer may fire one tick before the fast
+  decision: its phase-1a broadcast is counted but dead on arrival (the
+  oracle's new consensus instance rejects the stale configuration id).
+
+Everything here is shape-static: the schedule is a pytree of
+``[instances, capacity]`` arrays, so it threads through ``jit`` /
+``lax.scan`` and a run with ``fallback=None`` compiles the whole
+subsystem out.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rapid_tpu import hashing
+from rapid_tpu.engine.state import I32_MAX
+from rapid_tpu.engine.votes import fast_quorum, proposal_fingerprint, \
+    segmented_vote_count
+from rapid_tpu.settings import Settings
+
+_RANK_SEED = 0x72616E6B  # matches oracle.paxos.classic_rank_node_index
+
+
+class FallbackEnvelopeError(ValueError):
+    """The contested scenario leaves the envelope where the batched
+    fallback kernel is bit-identical to the oracle (module docstring)."""
+
+
+class FallbackSchedule(NamedTuple):
+    """Scripted contested consensus instances, one row per instance.
+
+    ``prop_pid[i, s] >= 0`` means slot ``s`` calls ``propose`` with
+    proposal ``table_mask[i, pid]`` at tick ``prop_tick[i, s]`` and arms
+    its fallback timer for ``prop_delay[i, s]`` ticks (the oracle's
+    explicit ``recovery_delay_ticks``, standing in for the per-node
+    expovariate jitter so both sides share one deterministic draw).
+    Instance ``i`` is live only while the configuration epoch equals
+    ``inst_epoch[i]`` — the engine analogue of the oracle's
+    configuration-id filter on consensus messages. ``table_hi``/``lo``
+    are the per-pid ``proposal_fingerprint`` limbs feeding the fast-round
+    segmented tally.
+    """
+
+    inst_epoch: np.ndarray   # int32 [I]
+    prop_tick: np.ndarray    # int32 [I, C]
+    prop_pid: np.ndarray     # int32 [I, C]  (-1 = no vote)
+    prop_delay: np.ndarray   # int32 [I, C]
+    table_mask: np.ndarray   # bool  [I, P, C]
+    table_hi: np.ndarray     # uint32 [I, P]
+    table_lo: np.ndarray     # uint32 [I, P]
+
+
+def empty_fallback_schedule(c: int, instances: int = 1,
+                            pids: int = 1) -> FallbackSchedule:
+    return FallbackSchedule(
+        inst_epoch=np.arange(instances, dtype=np.int32),
+        prop_tick=np.full((instances, c), I32_MAX, np.int32),
+        prop_pid=np.full((instances, c), -1, np.int32),
+        prop_delay=np.zeros((instances, c), np.int32),
+        table_mask=np.zeros((instances, pids, c), bool),
+        table_hi=np.zeros((instances, pids), np.uint32),
+        table_lo=np.zeros((instances, pids), np.uint32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+
+def classic_rank_index(xp, uid_hi, uid_lo):
+    """i32 [C]: the oracle's ``classic_rank_node_index`` per slot —
+    the low 31 bits of ``hash64(uid, seed=0x72616E6B)``."""
+    _, lo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=_RANK_SEED)
+    return (lo & xp.uint32(0x7FFFFFFF)).astype(xp.int32)
+
+
+def ring0_positions(xp, uid_hi, uid_lo, member):
+    """i32 [C]: each member's position in ring-0 order (the broadcaster's
+    recipient order, hence the phase-1b arrival order at the coordinator);
+    non-members read ``I32_MAX``.
+
+    Same sort key as ring 0 of ``topology.build_topology`` — the
+    ``hash64(uid, seed=0)`` with the uid as tiebreak."""
+    khi, klo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=0)
+    order = xp.lexsort((uid_lo, uid_hi, klo, khi)).astype(xp.int32)
+    member_s = member.astype(bool)[order]
+    mrank_s = xp.cumsum(member_s.astype(xp.int32)) - 1
+    rank = xp.argsort(order).astype(xp.int32)  # rank[slot] = sorted position
+    mpos = mrank_s[rank]
+    return xp.where(member, mpos, xp.int32(I32_MAX))
+
+
+def rank_lt(ar, ai, br, bi):
+    """(ar, ai) < (br, bi) lexicographically (the oracle's Rank order)."""
+    return (ar < br) | ((ar == br) & (ai < bi))
+
+
+def rank_eq(ar, ai, br, bi):
+    return (ar == br) & (ai == bi)
+
+
+def coordinator_rule_pid(xp, promised, pos, vval_pid, n, n_pids: int):
+    """The Fast Paxos Fig. 2 value-selection rule over arrival order.
+
+    The oracle's coordinator re-evaluates the rule at every phase-1b
+    arrival past the majority until it yields a non-empty value
+    (``Paxos.handle_phase1b`` + ``select_proposal_using_coordinator_rule``).
+    Promises arrive in ring-0 order (broadcast recipient order fixes the
+    reply sequence), so the first effective prefix has length
+    ``m* = max(N//2 + 1, first_value_position + 1)`` and the rule reduces
+    to masked segmented counts over that prefix:
+
+    - one distinct voted value -> that value;
+    - else the value whose cumulative count first exceeds ``N//4`` in
+      arrival order (the earliest ``(N//4 + 1)``-th occurrence);
+    - else the first voted value in arrival order.
+
+    Returns the chosen pid, or -1 when no promise carries a value (the
+    oracle broadcasts no phase 2a in that case). Assumes the fallback
+    envelope's single-round ``vrnd`` structure: a promise carries a value
+    iff its ``vrnd`` is the fast round, which is the unique maximum.
+    """
+    big = xp.int32(I32_MAX)
+    n4 = (n // 4).astype(xp.int32)
+    has_val = promised & (vval_pid >= 0)
+    pos_hv = xp.where(has_val, pos, big)
+    first_hv = pos_hv.min()
+    m_star = xp.maximum(n // 2 + 1, first_hv + 1)
+    cand = has_val & (pos < m_star)
+    pid_ids = xp.arange(n_pids, dtype=xp.int32)
+    pid_masks = cand[None, :] & (vval_pid[None, :] == pid_ids[:, None])
+    cnt = pid_masks.sum(axis=1).astype(xp.int32)
+    distinct = (cnt > 0).sum().astype(xp.int32)
+    single_pid = xp.argmax(cnt > 0).astype(xp.int32)
+    # Position of each pid's (N//4 + 1)-th occurrence within the prefix.
+    sorted_pos = xp.sort(xp.where(pid_masks, pos[None, :], big), axis=1)
+    cross = xp.where(cnt >= n4 + 1, sorted_pos[:, n4], big)
+    cross_pid = xp.argmin(cross).astype(xp.int32)
+    has_cross = cross.min() < big
+    fb_pid = vval_pid[xp.argmin(pos_hv)]
+    chosen = xp.where(distinct == 1, single_pid,
+                      xp.where(has_cross, cross_pid, fb_pid))
+    return xp.where(has_val.any(), chosen, xp.int32(-1))
+
+
+def _instance_row(xp, sched: FallbackSchedule, epoch):
+    """Gather the schedule row of the current epoch's instance."""
+    e = xp.clip(epoch, 0, sched.inst_epoch.shape[0] - 1)
+    live = sched.inst_epoch[e] == epoch
+    return e, live
+
+
+def chain_deliver(xp, state, sched: FallbackSchedule, t, n):
+    """Classic-chain deliveries at tick ``t``: 2b -> 2a -> 1b.
+
+    These messages were sent during the previous tick's delivery phase,
+    so they sort before fast-round votes and phase-1a broadcasts (task-
+    phase sends) in the oracle's per-tick seq order. Returns
+    ``(state, counts, classic_decide, classic_pid)`` where ``counts``
+    holds the phase-2a/2b sender factors generated by these deliveries.
+    Later chain stages are gated off once an earlier message decided —
+    the oracle's fresh consensus instance rejects their configuration id.
+    """
+    epoch = state.epoch
+    e, live = _instance_row(xp, sched, epoch)
+    maj = n // 2
+
+    # -- phase 2b: everyone counts accept votes; decide past majority ----
+    arr2b = live & (state.c2b_tick + 1 == t) & (state.c2b_epoch == epoch)
+    classic_decide = arr2b & (state.c2b_cnt > maj)
+    classic_pid = state.c2b_pid
+    gate = ~classic_decide
+
+    # -- phase 2a: acceptors accept and broadcast phase 2b ---------------
+    arr2a = live & gate & (state.c2a_tick + 1 == t) \
+        & (state.c2a_epoch == epoch)
+    accept = state.member & ~rank_lt(state.c2a_rank_r, state.c2a_rank_i,
+                                     state.px_rnd_r, state.px_rnd_i) \
+        & ~rank_eq(state.px_vrnd_r, state.px_vrnd_i,
+                   state.c2a_rank_r, state.c2a_rank_i) & arr2a
+    n_accept = accept.sum().astype(xp.int32)
+    state = state._replace(
+        px_rnd_r=xp.where(accept, state.c2a_rank_r, state.px_rnd_r),
+        px_rnd_i=xp.where(accept, state.c2a_rank_i, state.px_rnd_i),
+        px_vrnd_r=xp.where(accept, state.c2a_rank_r, state.px_vrnd_r),
+        px_vrnd_i=xp.where(accept, state.c2a_rank_i, state.px_vrnd_i),
+        px_vval=xp.where(accept, state.c2a_pid, state.px_vval),
+        c2b_tick=xp.where(arr2a, t, state.c2b_tick),
+        c2b_cnt=xp.where(arr2a, n_accept, state.c2b_cnt),
+        c2b_pid=xp.where(arr2a, state.c2a_pid, state.c2b_pid),
+        c2b_epoch=xp.where(arr2a, epoch, state.c2b_epoch),
+    )
+
+    # -- phase 1b: coordinator applies the rule past majority ------------
+    arr1b = live & gate & (state.c1b_tick + 1 == t) \
+        & (state.c1b_epoch == epoch)
+    n_promise = state.c1b_mask.sum().astype(xp.int32)
+    pos = state.px_pos
+    chosen = coordinator_rule_pid(xp, state.c1b_mask, pos, state.px_vval,
+                                  n, sched.table_mask.shape[1])
+    do2a = arr1b & (n_promise > maj) & (chosen >= 0)
+    state = state._replace(
+        c2a_tick=xp.where(do2a, t, state.c2a_tick),
+        c2a_pid=xp.where(do2a, chosen, state.c2a_pid),
+        c2a_rank_r=xp.where(do2a, state.c1a_rank_r, state.c2a_rank_r),
+        c2a_rank_i=xp.where(do2a, state.c1a_rank_i, state.c2a_rank_i),
+        c2a_epoch=xp.where(do2a, epoch, state.c2a_epoch),
+        px_cval=xp.where(
+            do2a & (xp.arange(state.px_cval.shape[0]) == state.c1a_coord),
+            chosen, state.px_cval),
+    )
+    counts = {
+        "px2a_senders": do2a.astype(xp.int32),
+        "px2a_recipients": xp.where(do2a, n, 0).astype(xp.int32),
+        "px2b_senders": xp.where(arr2a, n_accept, 0).astype(xp.int32),
+        "px2b_recipients": xp.where(arr2a, n, 0).astype(xp.int32),
+    }
+    return state, counts, classic_decide, classic_pid
+
+
+def fast_tally(xp, state, sched: FallbackSchedule, t, n, blocked):
+    """Scripted fast-round tally at tick ``t`` (after chain messages,
+    before phase-1a broadcasts, in seq order).
+
+    The delivered-vote set is derived from the schedule (a vote sent at
+    its propose tick arrives one tick later, and the instance epoch gate
+    expires stale votes exactly as the oracle's configuration-id check).
+    Reuses the limb-fingerprint segmented counter from ``votes.py``.
+    Returns ``(fast_decide, win_pid, tally, quorum)``.
+    """
+    epoch = state.epoch
+    e, live = _instance_row(xp, sched, epoch)
+    pid = sched.prop_pid[e]
+    delivered = live & state.member & (pid >= 0) \
+        & (sched.prop_tick[e] + 1 <= t)
+    safe_pid = xp.clip(pid, 0, sched.table_mask.shape[1] - 1)
+    vote_hi = sched.table_hi[e][safe_pid]
+    vote_lo = sched.table_lo[e][safe_pid]
+    per_vote = segmented_vote_count(xp, vote_hi, vote_lo, delivered)
+    total = delivered.sum().astype(xp.int32)
+    quorum = fast_quorum(xp, n)
+    decided = ~blocked & (total >= quorum) & (per_vote.max() >= quorum)
+    win_pid = xp.where(delivered & (per_vote >= quorum), pid,
+                       xp.int32(I32_MAX)).min()
+    tally = xp.where(total > 0, per_vote.max(), 0).astype(xp.int32)
+    return decided, win_pid, tally, quorum
+
+
+def phase1a_deliver(xp, state, sched: FallbackSchedule, t, n, decided_now):
+    """Phase-1a delivery at tick ``t`` (last in seq order: the broadcast
+    was a task-phase send). Acceptors with a lower rank promise and
+    unicast phase 1b to the coordinator; a decision earlier this tick
+    (or an epoch change since the send) kills the broadcast in flight."""
+    epoch = state.epoch
+    _, live = _instance_row(xp, sched, epoch)
+    arr1a = live & ~decided_now & (state.c1a_tick + 1 == t) \
+        & (state.c1a_epoch == epoch)
+    promise = state.member & rank_lt(state.px_rnd_r, state.px_rnd_i,
+                                     state.c1a_rank_r, state.c1a_rank_i) \
+        & arr1a
+    n_promise = promise.sum().astype(xp.int32)
+    state = state._replace(
+        px_rnd_r=xp.where(promise, state.c1a_rank_r, state.px_rnd_r),
+        px_rnd_i=xp.where(promise, state.c1a_rank_i, state.px_rnd_i),
+        c1b_mask=xp.where(arr1a, promise, state.c1b_mask),
+        c1b_tick=xp.where(arr1a, t, state.c1b_tick),
+        c1b_epoch=xp.where(arr1a, epoch, state.c1b_epoch),
+    )
+    counts = {"px1b_senders": xp.where(arr1a, n_promise, 0).astype(xp.int32)}
+    return state, counts
+
+
+def task_phase(xp, state, sched: FallbackSchedule, t, n, decided_now):
+    """Task-phase sends at tick ``t``: scripted proposes (fast-round vote
+    broadcast + own-vote registration + timer arming, in that order per
+    the oracle's ``FastPaxos.propose``), then timer fires (phase-1a
+    broadcast). Propose tasks hold pre-start scheduler handles, so they
+    run before timer tasks due the same tick; a decision this tick
+    cancelled every timer before the task queue ran."""
+    epoch = state.epoch
+    e, live = _instance_row(xp, sched, epoch)
+    pid = sched.prop_pid[e]
+
+    send = live & state.member & (pid >= 0) & (sched.prop_tick[e] == t)
+    n_send = send.sum().astype(xp.int32)
+    # register_fast_round_vote: only while the slot's rank round is <= 1
+    reg = send & (state.px_rnd_r <= 1)
+    state = state._replace(
+        px_rnd_r=xp.where(reg, 1, state.px_rnd_r),
+        px_rnd_i=xp.where(reg, 1, state.px_rnd_i),
+        px_vrnd_r=xp.where(reg, 1, state.px_vrnd_r),
+        px_vrnd_i=xp.where(reg, 1, state.px_vrnd_i),
+        px_vval=xp.where(reg, pid, state.px_vval),
+        px_timer=xp.where(send, t + sched.prop_delay[e], state.px_timer),
+    )
+
+    fire = state.member & ~decided_now & (state.px_timer == t)
+    n_fire = fire.sum().astype(xp.int32)
+    coord = xp.argmax(fire).astype(xp.int32)
+    rank_i = classic_rank_index(xp, state.uid_hi, state.uid_lo)[coord]
+    any_fire = fire.any()
+    slots = xp.arange(state.px_crnd_r.shape[0], dtype=xp.int32)
+    state = state._replace(
+        px_timer=xp.where(fire, I32_MAX, state.px_timer),
+        c1a_tick=xp.where(any_fire, t, state.c1a_tick),
+        c1a_coord=xp.where(any_fire, coord, state.c1a_coord),
+        c1a_rank_r=xp.where(any_fire, 2, state.c1a_rank_r),
+        c1a_rank_i=xp.where(any_fire, rank_i, state.c1a_rank_i),
+        c1a_epoch=xp.where(any_fire, epoch, state.c1a_epoch),
+        px_crnd_r=xp.where(any_fire & (slots == coord), 2, state.px_crnd_r),
+        px_crnd_i=xp.where(any_fire & (slots == coord), rank_i,
+                           state.px_crnd_i),
+    )
+    counts = {
+        "pxvote_senders": n_send,
+        "pxvote_recipients": xp.where(send.any(), n, 0).astype(xp.int32),
+        "px1a_senders": n_fire,
+        "px1a_recipients": xp.where(any_fire, n, 0).astype(xp.int32),
+    }
+    return state, counts
+
+
+# ---------------------------------------------------------------------------
+# host planner: envelope validation + outcome prediction
+# ---------------------------------------------------------------------------
+
+
+def np_ring0_positions(uids: np.ndarray, member: np.ndarray) -> np.ndarray:
+    """Host mirror of ``ring0_positions`` over uint64 uids."""
+    hi, lo = hashing.np_to_limbs(np.asarray(uids, np.uint64))
+    return np.asarray(ring0_positions(np, hi, lo, np.asarray(member, bool)))
+
+
+def host_coordinator_rule(n: int, positions: Dict[int, int],
+                          votes: Dict[int, int]) -> int:
+    """Python mirror of ``coordinator_rule_pid`` over slot -> ring0
+    position and slot -> pid maps (voters only). Used by the planner to
+    predict classic-round outcomes without running either simulation."""
+    if not votes:
+        return -1
+    order = sorted(votes, key=lambda s: positions[s])
+    first = positions[order[0]]
+    m_star = max(n // 2 + 1, first + 1)
+    prefix = [s for s in order if positions[s] < m_star]
+    pids = [votes[s] for s in prefix]
+    if len(set(pids)) == 1:
+        return pids[0]
+    counters: Dict[int, int] = {}
+    for value in pids:
+        count = counters.setdefault(value, 0)
+        if count + 1 > n // 4:
+            return value
+        counters[value] = count + 1
+    return pids[0]
+
+
+def expovariate_delay_ticks(u: float, n: int, settings: Settings) -> int:
+    """The oracle's ``FastPaxos.get_random_delay_ticks`` for a given
+    uniform draw — base delay plus expovariate jitter with rate 1/N."""
+    jitter_ms = -1000.0 * math.log(1.0 - u) * n
+    return settings.fallback_base_delay_ticks + max(
+        0, round(jitter_ms / settings.tick_ms))
+
+
+def plan_fallback(
+    n: int,
+    values: Sequence[Sequence[int]],
+    votes: Dict[int, Tuple[int, int]],
+    delays: Dict[int, int],
+    settings: Settings,
+    uids: Optional[np.ndarray] = None,
+    capacity: Optional[int] = None,
+    epoch: int = 0,
+    member: Optional[np.ndarray] = None,
+) -> Tuple[FallbackSchedule, Dict[str, object]]:
+    """Compile one contested instance and validate the envelope.
+
+    ``values[p]`` lists the member slots proposal ``p`` removes;
+    ``votes[s] = (tick, pid)`` scripts slot ``s``'s propose call;
+    ``delays[s]`` is its fallback delay in ticks. ``member`` optionally
+    names the live electorate as a bool ``[capacity]`` mask (defaults to
+    slots ``[0, n)``) — used when chaining instances whose decisions
+    removed members. Raises ``FallbackEnvelopeError`` for scenarios the
+    batched kernel does not reproduce bit-identically. Returns the
+    single-instance schedule plus an info dict with the predicted decide
+    tick, mode and winning pid.
+    """
+    c = capacity if capacity is not None else n
+    if member is None:
+        member = np.zeros(c, bool)
+        member[:n] = True
+    else:
+        member = np.asarray(member, bool)
+    n_live = int(member.sum())
+    if not values:
+        raise FallbackEnvelopeError("need at least one proposal value")
+    for p, val in enumerate(values):
+        if not val:
+            raise FallbackEnvelopeError(f"proposal {p} is empty")
+        if any(s < 0 or s >= c or not member[s] for s in val):
+            raise FallbackEnvelopeError(f"proposal {p} removes a non-member")
+    if not votes:
+        raise FallbackEnvelopeError("need at least one scripted propose")
+    for s, (tick, pid) in votes.items():
+        if s < 0 or s >= c or not member[s]:
+            raise FallbackEnvelopeError(f"voter {s} is not a member")
+        if not 0 <= pid < len(values):
+            raise FallbackEnvelopeError(f"voter {s} votes unknown pid {pid}")
+        if tick < 1:
+            # The oracle can only schedule a propose at a future tick and
+            # the engine sends during the task phase of tick >= 1.
+            raise FallbackEnvelopeError(
+                f"voter {s} proposes at tick {tick}; scripted proposes "
+                "need tick >= 1")
+        if s not in delays:
+            raise FallbackEnvelopeError(f"voter {s} has no fallback delay")
+        if delays[s] < 1:
+            raise FallbackEnvelopeError(f"voter {s} delay must be >= 1")
+
+    # Replay the fast-round tally on virtual time to find the decide tick.
+    quorum = n_live - (n_live - 1) // 4
+    by_arrival: Dict[int, List[int]] = {}
+    for s, (tick, pid) in votes.items():
+        by_arrival.setdefault(tick + 1, []).append(pid)
+    counts: Dict[int, int] = {}
+    total = 0
+    fast_decide_tick = None
+    fast_pid = None
+    for arr in sorted(by_arrival):
+        for pid in by_arrival[arr]:
+            counts[pid] = counts.get(pid, 0) + 1
+            total += 1
+        if fast_decide_tick is None and total >= quorum:
+            best = max(counts, key=lambda p: counts[p])
+            if counts[best] >= quorum:
+                fast_decide_tick, fast_pid = arr, best
+
+    fires = {s: votes[s][0] + delays[s] for s in votes}
+    min_fire = min(fires.values())
+    info: Dict[str, object] = {"n": n_live, "quorum": quorum}
+
+    if fast_decide_tick is not None:
+        # Fast path, possibly racing a timer: a fire one tick before the
+        # decision puts a phase-1a in flight that dies on arrival; any
+        # earlier fire starts a real classic round mid-count.
+        if min_fire < fast_decide_tick - 1:
+            raise FallbackEnvelopeError(
+                f"timer fires at {min_fire}, before the fast decision at "
+                f"{fast_decide_tick} completes (out of envelope)")
+        info.update(mode="fast", decide_tick=fast_decide_tick,
+                    winner=fast_pid,
+                    racing=bool(min_fire == fast_decide_tick - 1))
+    else:
+        firing = [s for s, f in fires.items() if f == min_fire]
+        if len(firing) != 1:
+            raise FallbackEnvelopeError(
+                f"{len(firing)} timers fire together at {min_fire}; the "
+                "envelope needs a unique first coordinator")
+        decide = min_fire + 4  # 1a -> 1b -> 2a -> 2b -> decide
+        late = [s for s, f in fires.items()
+                if s != firing[0] and f < decide]
+        if late:
+            raise FallbackEnvelopeError(
+                f"timers of {late} fire during the classic round "
+                f"({min_fire}..{decide}); the oracle would start a rank race")
+        late_votes = [s for s, (tick, _) in votes.items() if tick >= min_fire]
+        if late_votes:
+            raise FallbackEnvelopeError(
+                f"proposes of {late_votes} land mid-classic-round")
+        if uids is None:
+            from rapid_tpu.engine.diff import default_endpoints
+            from rapid_tpu.oracle.membership_view import uid_of
+            uids = np.asarray([uid_of(e) for e in default_endpoints(c)],
+                              np.uint64)
+        pos = np_ring0_positions(np.asarray(uids, np.uint64), member)
+        winner = host_coordinator_rule(
+            n_live, {s: int(pos[s]) for s in votes},
+            {s: pid for s, (_, pid) in votes.items()})
+        info.update(mode="classic", decide_tick=decide, winner=winner,
+                    coordinator=firing[0], fire_tick=min_fire)
+
+    sched = empty_fallback_schedule(c, instances=1, pids=len(values))
+    sched.inst_epoch[0] = epoch
+    for s, (tick, pid) in votes.items():
+        sched.prop_tick[0, s] = tick
+        sched.prop_pid[0, s] = pid
+        sched.prop_delay[0, s] = delays[s]
+    for p, val in enumerate(values):
+        sched.table_mask[0, p, list(val)] = True
+    _fingerprint_tables(sched, uids, c)
+    return sched, info
+
+
+def _fingerprint_tables(sched: FallbackSchedule, uids, c: int) -> None:
+    """Fill ``table_hi``/``table_lo`` from the masks (host-side numpy)."""
+    if uids is None:
+        from rapid_tpu.oracle.membership_view import uid_of
+
+        from rapid_tpu.engine.diff import default_endpoints
+        uids = np.asarray([uid_of(e) for e in default_endpoints(c)],
+                          np.uint64)
+    uhi, ulo = hashing.np_to_limbs(np.asarray(uids, np.uint64))
+    for i in range(sched.table_mask.shape[0]):
+        for p in range(sched.table_mask.shape[1]):
+            hi, lo = proposal_fingerprint(np, sched.table_mask[i, p],
+                                          uhi, ulo)
+            sched.table_hi[i, p] = hi
+            sched.table_lo[i, p] = lo
+
+
+def concat_schedules(parts: Sequence[FallbackSchedule]) -> FallbackSchedule:
+    """Stack single-instance schedules into one multi-instance script."""
+    return FallbackSchedule(*[np.concatenate([getattr(p, f) for p in parts])
+                              for f in FallbackSchedule._fields])
+
+
+def synthetic_contested_schedule(
+    n: int, settings: Settings, n_ticks: int, start: int = 5,
+    period: Optional[int] = None, uids: Optional[np.ndarray] = None,
+) -> Tuple[FallbackSchedule, Dict[str, object]]:
+    """Benchmark workload: repeated two-way contested instances.
+
+    Every ``period`` ticks the surviving members split into two camps
+    proposing to remove two different members; no fast quorum forms, the
+    lowest-slot member's timer fires after the base delay and the classic
+    round decides 4 ticks later. The winner of each round (predicted with
+    the host rule mirror) shapes the next instance's electorate.
+    ``uids`` must match the engine state's identities (defaults to the
+    differential harness endpoints).
+    """
+    if uids is None:
+        from rapid_tpu.engine.diff import default_endpoints
+        from rapid_tpu.oracle.membership_view import uid_of
+        uids = np.asarray([uid_of(e) for e in default_endpoints(n)],
+                          np.uint64)
+    base = settings.fallback_base_delay_ticks
+    round_len = base + 4
+    if period is None:
+        period = round_len + 6
+    member = np.ones(n, bool)
+    parts: List[FallbackSchedule] = []
+    decides: List[int] = []
+    tick = start
+    epoch = 0
+    while tick + round_len < n_ticks and member.sum() > 4:
+        members = np.nonzero(member)[0]
+        victims = members[-2:]
+        values = [[int(victims[0])], [int(victims[1])]]
+        votes = {int(s): (tick, int(i % 2))
+                 for i, s in enumerate(members)}
+        delays = {int(s): (base if s == members[0] else base + period)
+                  for s in members}
+        sched, info = plan_fallback(
+            n, values, votes, delays, settings, uids=uids, capacity=n,
+            epoch=epoch, member=member.copy())
+        parts.append(sched)
+        decides.append(int(info["decide_tick"]))
+        member[values[int(info["winner"])]] = False
+        tick += period
+        epoch += 1
+    info = {"instances": len(parts), "decide_ticks": decides,
+            "period": period}
+    if not parts:
+        return empty_fallback_schedule(n), info
+    return concat_schedules(parts), info

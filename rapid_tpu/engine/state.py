@@ -142,6 +142,38 @@ class EngineState(NamedTuple):
     phash_hi: object                  # u32 scalar proposal fingerprint
     phash_lo: object                  # u32 scalar
     epoch: object                     # i32 scalar: decided view changes so far
+    # classic-Paxos fallback (rapid_tpu.engine.paxos). Per-slot rank pairs
+    # mirror the oracle's Rank(round, node_index); the c1a/c1b/c2a/c2b
+    # scalars are the one in-flight classic chain (single round per
+    # instance within the fallback envelope). Inert zeros when the step
+    # runs with fallback=None.
+    px_rnd_r: object                  # i32 [C] promised rank (round, index)
+    px_rnd_i: object
+    px_vrnd_r: object                 # i32 [C] accepted-vote rank
+    px_vrnd_i: object
+    px_vval: object                   # i32 [C] accepted proposal pid (-1 none)
+    px_crnd_r: object                 # i32 [C] coordinator's own rank
+    px_crnd_i: object
+    px_cval: object                   # i32 [C] coordinator's chosen pid
+    px_timer: object                  # i32 [C] fallback fire tick (I32_MAX)
+    px_pos: object                    # i32 [C] ring-0 position among members
+    c1a_tick: object                  # i32: phase-1a broadcast send tick
+    c1a_coord: object                 # i32: coordinator slot
+    c1a_rank_r: object                # i32: coordinator rank
+    c1a_rank_i: object
+    c1a_epoch: object                 # i32: config epoch at send
+    c1b_tick: object                  # i32: phase-1b unicast send tick
+    c1b_epoch: object
+    c1b_mask: object                  # bool [C]: promisers
+    c2a_tick: object                  # i32: phase-2a broadcast send tick
+    c2a_rank_r: object
+    c2a_rank_i: object
+    c2a_pid: object                   # i32: value in flight (-1 none)
+    c2a_epoch: object
+    c2b_tick: object                  # i32: phase-2b broadcast send tick
+    c2b_cnt: object                   # i32: accepting acceptors
+    c2b_pid: object
+    c2b_epoch: object
 
 
 class StepLog(NamedTuple):
@@ -184,6 +216,18 @@ class StepLog(NamedTuple):
     quorum: object                    # i32: fast quorum at the vote count
     epoch: object                     # i32: config epoch after this tick
     churn_injected: object            # i32: churn alerts enqueued this tick
+    # --- classic-Paxos fallback factors + gauges ------------------------
+    pxvote_senders: object            # i32: scripted fast-vote broadcasters
+    pxvote_recipients: object         # i32
+    px1a_senders: object              # i32: phase-1a broadcasters (timer fires)
+    px1a_recipients: object           # i32
+    px1b_senders: object              # i32: promisers (unicast: 1 recipient)
+    px2a_senders: object              # i32: coordinators sending phase 2a
+    px2a_recipients: object           # i32
+    px2b_senders: object              # i32: acceptors sending phase 2b
+    px2b_recipients: object           # i32
+    px_timers_armed: object           # i32 gauge: armed fallback timers
+    px_coord_round: object            # i32 gauge: max classic round started
 
 
 def config_id_limbs(xp, idsum_hi, idsum_lo, memsum_hi, memsum_lo):
@@ -220,6 +264,7 @@ def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
     """
     import jax.numpy as jnp
 
+    from rapid_tpu.engine.paxos import ring0_positions
     from rapid_tpu.engine.topology import build_topology
     from rapid_tpu.oracle.membership_view import _SEED_MEMBER
 
@@ -278,6 +323,26 @@ def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
         voters=jnp.zeros((c,), bool),
         phash_hi=u32(0), phash_lo=u32(0),
         epoch=jnp.int32(0),
+        px_rnd_r=jnp.zeros((c,), jnp.int32),
+        px_rnd_i=jnp.zeros((c,), jnp.int32),
+        px_vrnd_r=jnp.zeros((c,), jnp.int32),
+        px_vrnd_i=jnp.zeros((c,), jnp.int32),
+        px_vval=jnp.full((c,), -1, jnp.int32),
+        px_crnd_r=jnp.zeros((c,), jnp.int32),
+        px_crnd_i=jnp.zeros((c,), jnp.int32),
+        px_cval=jnp.full((c,), -1, jnp.int32),
+        px_timer=jnp.full((c,), I32_MAX, jnp.int32),
+        px_pos=ring0_positions(jnp, uid_hi, uid_lo, member_arr),
+        c1a_tick=jnp.int32(I32_MAX), c1a_coord=jnp.int32(0),
+        c1a_rank_r=jnp.int32(0), c1a_rank_i=jnp.int32(0),
+        c1a_epoch=jnp.int32(-1),
+        c1b_tick=jnp.int32(I32_MAX), c1b_epoch=jnp.int32(-1),
+        c1b_mask=jnp.zeros((c,), bool),
+        c2a_tick=jnp.int32(I32_MAX), c2a_rank_r=jnp.int32(0),
+        c2a_rank_i=jnp.int32(0), c2a_pid=jnp.int32(-1),
+        c2a_epoch=jnp.int32(-1),
+        c2b_tick=jnp.int32(I32_MAX), c2b_cnt=jnp.int32(0),
+        c2b_pid=jnp.int32(-1), c2b_epoch=jnp.int32(-1),
     )
 
 

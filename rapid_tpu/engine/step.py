@@ -26,10 +26,19 @@ delivery phase) sort before alert batches (sent during its run_due phase):
    ``t % fd_interval == 0`` past the ``fd_gate``, every node probes its
    unique subjects and saturated counters enqueue their DOWN alerts.
 
+With a ``fallback`` schedule (``rapid_tpu.engine.paxos``), the delivery
+phase grows the classic-Paxos chain in oracle seq order: phase-2b/2a/1b
+messages (sent during the previous tick's delivery phase) land *before*
+fast-round votes, and phase-1a broadcasts (task-phase timer sends) land
+*after* them; the task phase appends scripted proposes and fallback-timer
+fires. A classic majority decides through the same view-change path as a
+fast quorum.
+
 ``step`` is pure and shape-static: ``engine_step`` is its jit, and
 ``simulate`` drives it through ``lax.scan`` inside a single jit so an
 n-tick run is one device dispatch. ``churn`` is an optional
-``ChurnSchedule`` pytree; passing None compiles the churn phase out.
+``ChurnSchedule`` pytree and ``fallback`` an optional
+``FallbackSchedule``; passing None compiles the respective phase out.
 ``trace_count()`` exposes how many times the step body has been traced
 (tests assert a single compilation).
 """
@@ -44,9 +53,10 @@ from jax import lax
 
 from rapid_tpu import hashing
 from rapid_tpu.engine import cut, monitor
+from rapid_tpu.engine import paxos as paxos_mod
 from rapid_tpu.engine import votes as votes_mod
-from rapid_tpu.engine.state import (EngineFaults, EngineState, StepLog,
-                                    config_id_limbs)
+from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
+                                    StepLog, config_id_limbs)
 from rapid_tpu.engine.topology import build_topology
 from rapid_tpu.settings import Settings
 
@@ -70,19 +80,36 @@ def reset_trace_count() -> None:
 
 
 def step(state: EngineState, faults: EngineFaults, settings: Settings,
-         churn=None) -> tuple:
+         churn=None, fallback=None) -> tuple:
     """Advance the engine by one tick; returns (new_state, StepLog)."""
     global _TRACE_COUNT
     _TRACE_COUNT += 1
 
     t = state.tick + 1
     crashed = monitor.crashed_at(faults, t)
+    n_member = state.member.sum().astype(jnp.int32)
+    c = state.member.shape[0]
+
+    # ---- phase 0: classic-Paxos chain deliveries (earliest seq order) --
+    if fallback is not None:
+        state, px_counts, classic_decide, classic_pid = \
+            paxos_mod.chain_deliver(jnp, state, fallback, t, n_member)
+        fast2_decide, win_pid, px_tally, px_quorum = paxos_mod.fast_tally(
+            jnp, state, fallback, t, n_member, classic_decide)
+        n_pids = fallback.table_mask.shape[1]
+        sc_pid = jnp.clip(
+            jnp.where(classic_decide, classic_pid, win_pid), 0, n_pids - 1)
+        e = jnp.clip(state.epoch, 0, fallback.inst_epoch.shape[0] - 1)
+        sc_mask = fallback.table_mask[e][sc_pid]
+        sc_decide = classic_decide | fast2_decide
+    else:
+        sc_decide = jnp.asarray(False)
+        sc_mask = jnp.zeros_like(state.member)
+        px_tally = px_quorum = jnp.int32(0)
 
     # ---- phase 1: vote delivery & decision -----------------------------
     votes_arriving = state.vote_pending & (state.announce_tick + 1 == t)
     valid = state.voters & ~crashed & votes_arriving
-    n_member = state.member.sum().astype(jnp.int32)
-    c = state.member.shape[0]
     decided, tally = votes_mod.count_fast_round(
         jnp,
         jnp.broadcast_to(state.phash_hi, (c,)),
@@ -92,19 +119,24 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     vote_quorum = jnp.where(
         votes_arriving, votes_mod.fast_quorum(jnp, n_member), 0
     ).astype(jnp.int32)
+    vote_tally = jnp.maximum(vote_tally, px_tally)
+    vote_quorum = jnp.maximum(vote_quorum, px_quorum)
     # A decision needs an alive receiver to count the votes.
-    decide_now = votes_arriving & decided & (state.member & ~crashed).any()
-    decision = state.proposal & decide_now
+    alert_decide = (votes_arriving & decided & ~sc_decide
+                    & (state.member & ~crashed).any())
+    decide_now = alert_decide | sc_decide
+    decision_mask = jnp.where(sc_decide, sc_mask, state.proposal)
+    decision = decision_mask & decide_now
 
     vote_senders_alive = jnp.where(
         votes_arriving, valid.sum(), 0).astype(jnp.int32)
     vote_deliver_alive = jnp.where(
         votes_arriving, (state.member & ~crashed).sum(), 0).astype(jnp.int32)
 
-    def do_view_change(_):
-        removed = state.proposal & state.member
-        joined = state.proposal & ~state.member
-        member = state.member ^ state.proposal
+    def do_view_change(pmask):
+        removed = pmask & state.member
+        joined = pmask & ~state.member
+        member = state.member ^ pmask
         rm = removed.astype(jnp.uint32)
         jn = joined.astype(jnp.uint32)
         rhi, rlo = hashing.sum64(jnp, state.mfp_hi * rm, state.mfp_lo * rm)
@@ -119,22 +151,51 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
             jnp, state.idsum_hi, state.idsum_lo, ihi, ilo)
         topo = build_topology(jnp, state.uid_hi, state.uid_lo, member,
                               settings.K)
-        return (member, ms_hi, ms_lo, id_hi, id_lo) + topo
+        pos = (paxos_mod.ring0_positions(jnp, state.uid_hi, state.uid_lo,
+                                         member)
+               if fallback is not None else state.px_pos)
+        return (member, ms_hi, ms_lo, id_hi, id_lo, pos) + topo
 
     def keep_view(_):
         return (state.member, state.memsum_hi, state.memsum_lo,
-                state.idsum_hi, state.idsum_lo,
+                state.idsum_hi, state.idsum_lo, state.px_pos,
                 state.subj_idx, state.obs_idx, state.gk_idx,
                 state.fd_active, state.fd_first)
 
-    (member, memsum_hi, memsum_lo, idsum_hi, idsum_lo, subj_idx, obs_idx,
-     gk_idx, fd_active, fd_first) = lax.cond(
-        decide_now, do_view_change, keep_view, None)
+    (member, memsum_hi, memsum_lo, idsum_hi, idsum_lo, px_pos, subj_idx,
+     obs_idx, gk_idx, fd_active, fd_first) = lax.cond(
+        decide_now, do_view_change, keep_view, decision_mask)
+
+    px_resets = {}
+    if fallback is not None:
+        # A decision replaces the consensus instance: ranks back to zero,
+        # chosen values cleared, every fallback timer cancelled and the
+        # in-flight classic chain dropped (the oracle's fresh FastPaxos
+        # plus the configuration-id filter on stale messages).
+        zero_c = jnp.zeros((c,), jnp.int32)
+        neg_c = jnp.full((c,), -1, jnp.int32)
+        px_resets = dict(
+            px_rnd_r=jnp.where(decide_now, zero_c, state.px_rnd_r),
+            px_rnd_i=jnp.where(decide_now, zero_c, state.px_rnd_i),
+            px_vrnd_r=jnp.where(decide_now, zero_c, state.px_vrnd_r),
+            px_vrnd_i=jnp.where(decide_now, zero_c, state.px_vrnd_i),
+            px_vval=jnp.where(decide_now, neg_c, state.px_vval),
+            px_crnd_r=jnp.where(decide_now, zero_c, state.px_crnd_r),
+            px_crnd_i=jnp.where(decide_now, zero_c, state.px_crnd_i),
+            px_cval=jnp.where(decide_now, neg_c, state.px_cval),
+            px_timer=jnp.where(decide_now, I32_MAX, state.px_timer),
+            c1a_tick=jnp.where(decide_now, I32_MAX, state.c1a_tick),
+            c1b_tick=jnp.where(decide_now, I32_MAX, state.c1b_tick),
+            c1b_mask=state.c1b_mask & ~decide_now,
+            c2a_tick=jnp.where(decide_now, I32_MAX, state.c2a_tick),
+            c2b_tick=jnp.where(decide_now, I32_MAX, state.c2b_tick),
+        )
 
     mid = state._replace(
         tick=t, member=member,
         memsum_hi=memsum_hi, memsum_lo=memsum_lo,
         idsum_hi=idsum_hi, idsum_lo=idsum_lo,
+        px_pos=px_pos,
         subj_idx=subj_idx, obs_idx=obs_idx, gk_idx=gk_idx,
         fd_active=fd_active, fd_first=fd_first,
         fc=jnp.where(decide_now, 0, state.fc),
@@ -151,7 +212,14 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         vote_pending=state.vote_pending & ~votes_arriving,
         voters=state.voters & ~decide_now,
         epoch=state.epoch + decide_now.astype(jnp.int32),
+        **px_resets,
     )
+
+    # ---- phase 1b: late phase-1a delivery (task-phase send, last seq) --
+    if fallback is not None:
+        mid, px1b_counts = paxos_mod.phase1a_deliver(
+            jnp, mid, fallback, t, n_member, decide_now)
+        px_counts.update(px1b_counts)
 
     # ---- phase 2: alert delivery, aggregation, announce + vote cast ----
     src_alive = ~crashed
@@ -220,6 +288,22 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         pending_flush=notify_exp & is_fd,
     )
 
+    # ---- phase 4c: fallback task phase (proposes + timer fires) --------
+    if fallback is not None:
+        new_state, px_task_counts = paxos_mod.task_phase(
+            jnp, new_state, fallback, t, n_member_now, decide_now)
+        px_counts.update(px_task_counts)
+        px_timers_armed = (new_state.px_timer != I32_MAX).sum() \
+            .astype(jnp.int32)
+        px_coord_round = new_state.px_crnd_r.max().astype(jnp.int32)
+    else:
+        zero = jnp.int32(0)
+        px_counts = {f: zero for f in (
+            "pxvote_senders", "pxvote_recipients", "px1a_senders",
+            "px1a_recipients", "px1b_senders", "px2a_senders",
+            "px2a_recipients", "px2b_senders", "px2b_recipients")}
+        px_timers_armed = px_coord_round = zero
+
     cfg_hi, cfg_lo = config_id_limbs(
         jnp, new_state.idsum_hi, new_state.idsum_lo,
         new_state.memsum_hi, new_state.memsum_lo)
@@ -254,32 +338,45 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         quorum=vote_quorum,
         epoch=new_state.epoch,
         churn_injected=churn_injected,
+        pxvote_senders=px_counts["pxvote_senders"],
+        pxvote_recipients=px_counts["pxvote_recipients"],
+        px1a_senders=px_counts["px1a_senders"],
+        px1a_recipients=px_counts["px1a_recipients"],
+        px1b_senders=px_counts["px1b_senders"],
+        px2a_senders=px_counts["px2a_senders"],
+        px2a_recipients=px_counts["px2a_recipients"],
+        px2b_senders=px_counts["px2b_senders"],
+        px2b_recipients=px_counts["px2b_recipients"],
+        px_timers_armed=px_timers_armed,
+        px_coord_round=px_coord_round,
     )
     return new_state, log
 
 
 @partial(jax.jit, static_argnums=(2,))
 def engine_step(state: EngineState, faults: EngineFaults,
-                settings: Settings, churn=None) -> tuple:
+                settings: Settings, churn=None, fallback=None) -> tuple:
     """One jitted tick — a single device dispatch per call."""
-    return step(state, faults, settings, churn)
+    return step(state, faults, settings, churn, fallback)
 
 
 @partial(jax.jit, static_argnums=(2, 3))
-def _simulate(state, faults, n_ticks: int, settings: Settings, churn=None):
+def _simulate(state, faults, n_ticks: int, settings: Settings, churn=None,
+              fallback=None):
     def body(carry, _):
-        return step(carry, faults, settings, churn)
+        return step(carry, faults, settings, churn, fallback)
 
     return lax.scan(body, state, None, length=n_ticks)
 
 
 def simulate(state: EngineState, faults: EngineFaults, n_ticks: int,
-             settings: Settings, churn=None) -> tuple:
+             settings: Settings, churn=None, fallback=None) -> tuple:
     """Run ``n_ticks`` engine steps as one jitted ``lax.scan``.
 
     Returns (final_state, logs) where each ``logs`` field is stacked with
     a leading ``n_ticks`` axis. ``churn`` is an optional ``ChurnSchedule``
-    (see ``rapid_tpu.engine.churn``); None compiles to the crash-only
-    engine.
+    (see ``rapid_tpu.engine.churn``) and ``fallback`` an optional
+    ``FallbackSchedule`` (see ``rapid_tpu.engine.paxos``); None compiles
+    the respective subsystem out.
     """
-    return _simulate(state, faults, int(n_ticks), settings, churn)
+    return _simulate(state, faults, int(n_ticks), settings, churn, fallback)

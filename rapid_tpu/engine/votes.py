@@ -2,8 +2,8 @@
 
 Mirrors ``FastPaxos._handle_fast_round_proposal``: every alive node that
 announced a proposal broadcasts one fast-round vote; a receiver decides
-when it has seen at least ``N - floor((N-1)/4)`` votes total (the
-ceil(3N/4) fast quorum) *and* one proposal value holds that many votes.
+when it has seen at least ``N - floor((N-1)/4)`` votes total (the fast
+quorum) *and* one proposal value holds that many votes.
 
 Votes are counted as a segmented bincount over 64-bit proposal
 fingerprints: sort the (hi, lo) vote hashes, mark segment starts, and
@@ -11,7 +11,7 @@ fingerprints: sort the (hi, lo) vote hashes, mark segment starts, and
 The engine's crash-fault pipeline produces a single proposal value per
 configuration (every alive receiver aggregates the identical alert
 stream), but the counter is written for the general multi-proposal case so
-the classic-round fallback kernel (roadmap) can reuse it.
+the classic-round fallback kernel (``engine.paxos``) can reuse it.
 """
 from __future__ import annotations
 
@@ -55,7 +55,13 @@ def segmented_vote_count(xp, vote_hi, vote_lo, valid):
 
 
 def fast_quorum(xp, n_member):
-    """ceil(3N/4) as the reference computes it: N - floor((N-1)/4)."""
+    """The fast-round quorum as the reference computes it:
+    ``N - floor((N-1)/4)``, i.e. ``N - f`` for ``f = floor((N-1)/4)``.
+
+    This is *not* ceil(3N/4): they diverge whenever ``N % 4 == 0``
+    (e.g. N=4 -> 4 vs ceil(3N/4)=3, N=8 -> 7 vs 6).
+    ``tests/test_paxos.py`` pins this against the oracle at small N.
+    """
     return (n_member - (n_member - 1) // 4).astype(xp.int32)
 
 

@@ -40,6 +40,34 @@ ReplyFn = Callable[[object], None]
 # A server handler receives (request, reply) and may call reply now or later.
 ServerHandler = Callable[[RapidRequest, ReplyFn], None]
 
+# Consensus message classes tracked per phase for the fallback differential
+# (rapid_tpu.engine.diff.run_fallback_differential). Kept separate from
+# NetworkCounters so the existing total-parity checks are untouched.
+CONSENSUS_PHASES = ("fast_vote", "phase1a", "phase1b", "phase2a", "phase2b")
+
+
+def consensus_phase_of(request: RapidRequest) -> Optional[str]:
+    """Phase key for a consensus message, None for everything else."""
+    from rapid_tpu.types import (FastRoundPhase2bMessage, Phase1aMessage,
+                                 Phase1bMessage, Phase2aMessage,
+                                 Phase2bMessage)
+    if isinstance(request, FastRoundPhase2bMessage):
+        return "fast_vote"
+    if isinstance(request, Phase1aMessage):
+        return "phase1a"
+    if isinstance(request, Phase1bMessage):
+        return "phase1b"
+    if isinstance(request, Phase2aMessage):
+        return "phase2a"
+    if isinstance(request, Phase2bMessage):
+        return "phase2b"
+    return None
+
+
+def empty_consensus_counters() -> Dict[str, int]:
+    return {f"{p}_{kind}": 0
+            for p in CONSENSUS_PHASES for kind in ("sent", "delivered")}
+
 
 @dataclass
 class NetworkCounters:
@@ -127,6 +155,11 @@ class SimNetwork:
         # oracle half of the telemetry layer's unified TickMetrics stream
         # (rapid_tpu.telemetry.metrics.oracle_metrics).
         self.tick_history: List[Dict[str, int]] = []
+        # Per-phase consensus message accounting (cumulative + per-tick),
+        # network-level: a message to a kicked-but-registered node still
+        # counts as delivered, exactly like NetworkCounters.delivered.
+        self.consensus_counters: Dict[str, int] = empty_consensus_counters()
+        self.consensus_history: List[Dict[str, int]] = []
 
     @property
     def tick(self) -> int:
@@ -155,6 +188,9 @@ class SimNetwork:
              timeout_ticks: Optional[int] = None) -> None:
         """Queue a message for delivery next tick."""
         self.counters.sent += 1
+        phase = consensus_phase_of(request)
+        if phase is not None:
+            self.consensus_counters[f"{phase}_sent"] += 1
         deliver_at = self.tick + 1
         self._in_flight.setdefault(deliver_at, []).append(
             (next(self._seq), src, dst, request, on_response)
@@ -220,6 +256,7 @@ class SimNetwork:
     def step(self) -> None:
         """Advance one tick: deliver due messages, then run due tasks."""
         before = self.counters.snapshot()
+        consensus_before = dict(self.consensus_counters)
         t = self.tick + 1
         self.scheduler._advance(t)
         for seq, src, dst, request, reply in sorted(self._in_flight.pop(t, [])):
@@ -235,6 +272,9 @@ class SimNetwork:
                 self.counters.dropped += 1
                 continue
             self.counters.delivered += 1
+            phase = consensus_phase_of(request)
+            if phase is not None:
+                self.consensus_counters[f"{phase}_delivered"] += 1
             if reply is not None:
                 # Route the reply back through the network (subject to faults).
                 def reply_via_net(resp, src=src, dst=dst, reply=reply):
@@ -245,6 +285,9 @@ class SimNetwork:
         self.scheduler._run_due(t)
         self.last_tick_counters = self.counters.delta(before)
         self.tick_history.append(self.last_tick_counters.as_dict())
+        self.consensus_history.append(
+            {k: v - consensus_before[k]
+             for k, v in self.consensus_counters.items()})
 
     def _deliver_reply(self, src: Endpoint, dst: Endpoint, resp: object,
                        reply: ReplyFn) -> None:

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -56,6 +56,18 @@ class TickMetrics:
     vote_tally: int = UNOBSERVED
     quorum: int = UNOBSERVED
     churn_injected: int = UNOBSERVED
+    # consensus-fallback gauges (engine-derived; UNOBSERVED on the oracle
+    # and whenever the run has no fallback schedule). The per-phase sent
+    # gauges are *not* counters: the oracle's alert-path fast votes land
+    # in ``sent``, so cross-side per-phase parity is asserted only by
+    # ``diff.FallbackDiffResult`` against ``SimNetwork.consensus_history``.
+    px_timers_armed: int = UNOBSERVED
+    px_coord_round: int = UNOBSERVED
+    px_fast_vote_sent: int = UNOBSERVED
+    px_phase1a_sent: int = UNOBSERVED
+    px_phase1b_sent: int = UNOBSERVED
+    px_phase2a_sent: int = UNOBSERVED
+    px_phase2b_sent: int = UNOBSERVED
     # protocol events at this tick
     announce: bool = False
     decide: bool = False
@@ -89,9 +101,11 @@ def engine_metrics(logs) -> List[TickMetrics]:
     products); gauges are read straight off the log's end-of-tick
     snapshot fields.
     """
-    from rapid_tpu.engine.diff import expand_counters
+    from rapid_tpu.engine.diff import expand_counters, \
+        expand_fallback_counters
 
     counters = expand_counters(logs)
+    px = expand_fallback_counters(logs)
     ticks = np.asarray(logs.tick)
     ann = np.asarray(logs.announce_now)
     dec = np.asarray(logs.decide_now)
@@ -103,6 +117,8 @@ def engine_metrics(logs) -> List[TickMetrics]:
     tally = np.asarray(logs.vote_tally)
     quorum = np.asarray(logs.quorum)
     churned = np.asarray(logs.churn_injected)
+    timers_armed = np.asarray(logs.px_timers_armed)
+    coord_round = np.asarray(logs.px_coord_round)
 
     out: List[TickMetrics] = []
     for i, c in enumerate(counters):
@@ -116,6 +132,13 @@ def engine_metrics(logs) -> List[TickMetrics]:
             vote_tally=int(tally[i]),
             quorum=int(quorum[i]),
             churn_injected=int(churned[i]),
+            px_timers_armed=int(timers_armed[i]),
+            px_coord_round=int(coord_round[i]),
+            px_fast_vote_sent=px[i]["fast_vote_sent"],
+            px_phase1a_sent=px[i]["phase1a_sent"],
+            px_phase1b_sent=px[i]["phase1b_sent"],
+            px_phase2a_sent=px[i]["phase2a_sent"],
+            px_phase2b_sent=px[i]["phase2b_sent"],
             announce=bool(ann[i]),
             decide=bool(dec[i]),
         ))
@@ -193,6 +216,10 @@ class RunSummary:
     total_timeouts: int
     total_probes_sent: int
     total_probes_failed: int
+    # consensus-fallback traffic totals per phase (fast_vote, phase1a,
+    # phase1b, phase2a, phase2b); all-zero when the run had no fallback
+    # schedule (UNOBSERVED gauges are excluded from the sums).
+    fallback_phase_sent: Dict[str, int] = field(default_factory=dict)
 
     def as_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -211,10 +238,20 @@ def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
     window_sent = 0
     window_delivered = 0
     totals = dict.fromkeys(COUNTER_FIELDS, 0)
+    px_fields = (("fast_vote", "px_fast_vote_sent"),
+                 ("phase1a", "px_phase1a_sent"),
+                 ("phase1b", "px_phase1b_sent"),
+                 ("phase2a", "px_phase2a_sent"),
+                 ("phase2b", "px_phase2b_sent"))
+    px_totals = {phase: 0 for phase, _ in px_fields}
 
     for m in metrics:
         for f in COUNTER_FIELDS:
             totals[f] += getattr(m, f)
+        for phase, attr in px_fields:
+            v = getattr(m, attr)
+            if v >= 0:  # UNOBSERVED (oracle records) stays out of the sum
+                px_totals[phase] += v
         window_sent += m.sent
         window_delivered += m.delivered
         if m.announce:
@@ -257,4 +294,5 @@ def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
         total_timeouts=totals["timeouts"],
         total_probes_sent=totals["probes_sent"],
         total_probes_failed=totals["probes_failed"],
+        fallback_phase_sent=px_totals,
     )

@@ -36,7 +36,12 @@ TELEMETRY_SPEC = {
     "total_timeouts": (int,),
     "total_probes_sent": (int,),
     "total_probes_failed": (int,),
+    "fallback_phase_sent": (dict,),
 }
+
+#: Keys of the fallback_phase_sent block (matches engine.diff._PX_CLASSES
+#: and the oracle's SimNetwork consensus phases).
+FALLBACK_PHASES = ("fast_vote", "phase1a", "phase1b", "phase2a", "phase2b")
 
 VIEW_CHANGE_SPEC = {
     "announce_tick": _OPT_INT,
@@ -80,6 +85,11 @@ def validate_telemetry(block, where: str = "telemetry") -> List[str]:
         for i, vc in enumerate(block.get("view_changes") or []):
             errors += _check(vc, VIEW_CHANGE_SPEC,
                              f"{where}.view_changes[{i}]")
+        px = block.get("fallback_phase_sent")
+        if isinstance(px, dict):
+            errors += _check(
+                px, {phase: (int,) for phase in FALLBACK_PHASES},
+                f"{where}.fallback_phase_sent")
     return errors
 
 
@@ -98,7 +108,7 @@ def validate_bench_payload(payload) -> List[str]:
         return ["payload: expected a JSON object"]
     if payload.get("bench") == "engine_tick_suite":
         errors = []
-        for key in ("steady", "churn"):
+        for key in ("steady", "churn", "contested"):
             if key not in payload:
                 errors.append(f"payload.{key}: missing")
             else:

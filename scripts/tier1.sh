@@ -30,4 +30,19 @@ if [ "$rc" -eq 0 ]; then
         rc=1
     fi
 fi
+
+# Contested-consensus smoke: the classic-Paxos fallback scenario must run
+# end to end (48 ticks fits two contested instances) and emit a payload
+# that carries the per-phase fallback telemetry.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/bench_engine.py \
+            --scenario contested --n 256 --ticks 48 \
+            --out /tmp/_t1_contested.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_contested.json; then
+        echo CONTESTED_SMOKE=ok
+    else
+        echo CONTESTED_SMOKE=failed
+        rc=1
+    fi
+fi
 exit $rc

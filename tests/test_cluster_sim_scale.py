@@ -33,6 +33,29 @@ def verify_agreement(clusters, expected_size):
 
 
 @pytest.mark.slow
+def test_thousand_node_contested_consensus():
+    """Contested consensus at 1k nodes: two camps split the vote far below
+    the fast quorum, slot 0's fallback timer fires, and the classic-Paxos
+    round decides — bit-identical between oracle and engine, including the
+    per-phase 1a/1b/2a/2b message counts."""
+    from rapid_tpu.engine.diff import run_fallback_differential
+
+    n = N
+    values = [[0], [1]]
+    # 120 voters (60 per camp) keep the oracle's delivery count tractable;
+    # the other 880 members still promise and accept in the classic round.
+    votes = {s: (6, s % 2) for s in range(120)}
+    delays = {s: (10 if s == 0 else 100) for s in votes}
+    res = run_fallback_differential(n, values, votes, delays, n_ticks=30)
+    res.assert_identical()
+    assert res.plan_info["mode"] == "classic"
+    assert [e.kind for e in res.oracle_events] == ["view_change"]
+    # every member promised and accepted: 1b unicasts and 2a fan-out at N
+    assert sum(c["phase1b_sent"] for c in res.oracle_phase_counters) == n
+    assert sum(c["phase2b_sent"] for c in res.oracle_phase_counters) == n * n
+
+
+@pytest.mark.slow
 def test_thousand_node_cluster_sim_bootstrap():
     crash = CrashFault()
     endpoints = default_endpoints(N)

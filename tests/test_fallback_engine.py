@@ -1,0 +1,236 @@
+"""Classic-Paxos fallback kernel vs the oracle (engine.paxos).
+
+The acceptance contract: ``run_fallback_differential`` proves the batched
+kernel bit-identical to ``oracle.paxos`` — decision values, decided tick,
+configuration id, and per-phase 1a/1b/2a/2b message counts — at N=64 and
+N=256 for a two-way split vote, a three-way split, and a fallback timer
+racing a late fast-round quorum. Alongside: the host planner's envelope
+rejections, the engine/oracle rank-index and quorum-size parity pins, and
+the synthetic contested benchmark schedule.
+"""
+import numpy as np
+import pytest
+
+from rapid_tpu import hashing
+from rapid_tpu.engine.diff import (
+    default_endpoints,
+    engine_events,
+    run_fallback_differential,
+)
+from rapid_tpu.engine.paxos import (
+    FallbackEnvelopeError,
+    classic_rank_index,
+    plan_fallback,
+    synthetic_contested_schedule,
+)
+from rapid_tpu.engine.votes import fast_quorum
+from rapid_tpu.oracle.membership_view import uid_of
+from rapid_tpu.oracle.paxos import FastPaxos, classic_rank_node_index
+from rapid_tpu.oracle.testkit import (
+    ManualScheduler,
+    NoOpBroadcaster,
+    NoOpClient,
+)
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage
+
+SETTINGS = Settings()
+
+
+# ---------------------------------------------------------------------------
+# contested scenarios (parametrized by cluster size)
+# ---------------------------------------------------------------------------
+
+
+def two_way_split(n):
+    """Half the members vote to remove slot 0, half to remove slot 1; no
+    fast quorum, slot 0's timer fires first and the classic round decides."""
+    values = [[0], [1]]
+    votes = {s: (6, s % 2) for s in range(n)}
+    delays = {s: (10 if s == 0 else 100) for s in range(n)}
+    return values, votes, delays, 30
+
+
+def three_way_split(n):
+    """Three camps, none near the fast quorum; the highest slot's timer
+    fires first so the coordinator is not a slot-0 special case."""
+    a = n - 2 * (n // 3)
+    values = [[0], [1], [2]]
+    votes = {s: (6, 0 if s < a else (1 if s < a + n // 3 else 2))
+             for s in range(n)}
+    delays = {s: (10 if s == n - 1 else 100) for s in range(n)}
+    return values, votes, delays, 30
+
+
+def fallback_racing_fast_quorum(n):
+    """A straggler's vote completes the fast quorum at tick 20, one tick
+    after slot 0's fallback timer fired: the phase-1a broadcast is on the
+    wire when the decision lands and must die on arrival — counted, but
+    with no protocol effect."""
+    q = n - (n - 1) // 4
+    values = [[0], [1]]
+    votes = {s: (6, 0 if s < q - 1 else 1) for s in range(n - 1)}
+    votes[n - 1] = (19, 0)
+    delays = {s: 100 for s in range(n)}
+    delays[0] = 13
+    return values, votes, delays, 30
+
+
+SCENARIOS = {
+    "two_way_split": two_way_split,
+    "three_way_split": three_way_split,
+    "racing_fast_quorum": fallback_racing_fast_quorum,
+}
+
+
+@pytest.mark.parametrize("n", [64, 256])
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_fallback_differential_bit_identical(n, scenario):
+    values, votes, delays, ticks = SCENARIOS[scenario](n)
+    res = run_fallback_differential(n, values, votes, delays, ticks)
+    res.assert_identical()
+    # exactly one decision, at the tick and value the planner predicted
+    assert [e.kind for e in res.oracle_events] == ["view_change"]
+    assert res.oracle_events[0].tick == res.plan_info["decide_tick"]
+    winner = int(res.plan_info["winner"])
+    assert res.oracle_events[0].slots == tuple(sorted(values[winner]))
+    # the contested path really ran: classic rounds carry 1a/1b/2a/2b
+    # traffic, the racing scenario a dead-on-arrival 1a broadcast
+    total_1a = sum(c["phase1a_sent"] for c in res.engine_phase_counters)
+    assert total_1a == n
+    if res.plan_info["mode"] == "classic":
+        assert sum(c["phase2b_sent"] for c in res.engine_phase_counters) > 0
+    else:
+        assert res.plan_info["racing"] is True
+        assert sum(c["phase1b_sent"] for c in res.engine_phase_counters) == 0
+
+
+def test_fallback_phase_totals_reach_run_summary():
+    """The per-phase traffic shows up in RunSummary.fallback_phase_sent."""
+    from rapid_tpu.telemetry.metrics import summarize
+
+    n = 8
+    values, votes, delays, ticks = two_way_split(n)
+    res = run_fallback_differential(n, values, votes, delays, ticks)
+    res.assert_identical()
+    summary = summarize(res.engine_metrics)
+    expected = {
+        phase: sum(c[f"{phase}_sent"] for c in res.oracle_phase_counters)
+        for phase in ("fast_vote", "phase1a", "phase1b", "phase2a",
+                      "phase2b")
+    }
+    assert summary.fallback_phase_sent == expected
+    assert expected["phase1a"] == n and expected["phase2b"] == n * n
+
+
+# ---------------------------------------------------------------------------
+# planner envelope rejections
+# ---------------------------------------------------------------------------
+
+
+def _base_scenario(n=8):
+    values = [[0], [1]]
+    votes = {s: (6, s % 2) for s in range(n)}
+    delays = {s: (10 if s == 0 else 100) for s in range(n)}
+    return values, votes, delays
+
+
+def test_plan_rejects_timer_firing_mid_fast_count():
+    n = 8
+    q = n - (n - 1) // 4
+    values = [[0], [1]]
+    votes = {s: (6, 0) for s in range(q - 1)}
+    votes[n - 1] = (10, 0)  # straggler completes the fast quorum at 11
+    delays = {s: 100 for s in votes}
+    delays[0] = 2           # fires at 8, while votes are still arriving
+    with pytest.raises(FallbackEnvelopeError, match="before the fast"):
+        plan_fallback(n, values, votes, delays, SETTINGS)
+
+
+def test_plan_rejects_tied_first_timers():
+    values, votes, delays = _base_scenario()
+    delays[1] = delays[0]
+    with pytest.raises(FallbackEnvelopeError, match="unique first"):
+        plan_fallback(8, values, votes, delays, SETTINGS)
+
+
+def test_plan_rejects_second_fire_during_classic_round():
+    values, votes, delays = _base_scenario()
+    delays[1] = delays[0] + 2  # lands between 1a and the decide
+    with pytest.raises(FallbackEnvelopeError, match="rank race"):
+        plan_fallback(8, values, votes, delays, SETTINGS)
+
+
+def test_plan_rejects_pre_start_propose_tick():
+    values, votes, delays = _base_scenario()
+    votes[3] = (0, 1)
+    with pytest.raises(FallbackEnvelopeError, match="tick >= 1"):
+        plan_fallback(8, values, votes, delays, SETTINGS)
+
+
+def test_plan_rejects_non_member_voter():
+    values, votes, delays = _base_scenario()
+    member = np.ones(8, bool)
+    member[5] = False
+    with pytest.raises(FallbackEnvelopeError, match="not a member"):
+        plan_fallback(8, values, votes, delays, SETTINGS, member=member)
+
+
+# ---------------------------------------------------------------------------
+# engine/oracle parity pins: rank index and fast-quorum size
+# ---------------------------------------------------------------------------
+
+
+def test_classic_rank_index_matches_oracle():
+    endpoints = default_endpoints(32)
+    uids = np.asarray([uid_of(e) for e in endpoints], np.uint64)
+    hi, lo = hashing.np_to_limbs(uids)
+    idx = classic_rank_index(np, hi, lo)
+    for s, e in enumerate(endpoints):
+        assert int(idx[s]) == classic_rank_node_index(e)
+
+
+@pytest.mark.parametrize("n", list(range(2, 17)) + [20, 21])
+def test_fast_quorum_matches_oracle_minimal_decide(n):
+    """Pin the engine's quorum size to the oracle's observed behavior: the
+    smallest number of identical fast votes that makes FastPaxos decide.
+    Catches the ceil(3N/4) misreading, which diverges at N % 4 == 0."""
+    proposal = (Endpoint("p.sim", 1),)
+    min_votes = None
+    for k in range(1, n + 1):
+        decided = []
+        fp = FastPaxos(Endpoint("me.sim", 0), 1, n, NoOpClient(),
+                       NoOpBroadcaster(), ManualScheduler(), decided.append)
+        for i in range(k):
+            fp.handle_messages(
+                FastRoundPhase2bMessage(Endpoint("v.sim", i), 1, proposal))
+        if decided:
+            min_votes = k
+            break
+    assert min_votes == int(fast_quorum(np, np.int32(n)))
+    if n % 4 == 0:
+        assert min_votes != -(-3 * n) // 4  # ceil(3N/4) undercounts here
+
+
+# ---------------------------------------------------------------------------
+# synthetic contested schedule (the benchmark workload), engine-only
+# ---------------------------------------------------------------------------
+
+
+def test_synthetic_contested_schedule_decides_every_instance():
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.step import simulate
+
+    n, ticks = 32, 70
+    endpoints = default_endpoints(n)
+    uids = np.asarray([uid_of(e) for e in endpoints], np.uint64)
+    sched, info = synthetic_contested_schedule(n, SETTINGS, ticks, uids=uids)
+    assert info["instances"] >= 2
+
+    state = init_state(uids, id_fp_sum=0, settings=SETTINGS)
+    faults = crash_faults([I32_MAX] * n)
+    final, logs = simulate(state, faults, ticks, SETTINGS, fallback=sched)
+    decided = [e for e in engine_events(logs) if e.kind == "view_change"]
+    assert [e.tick for e in decided] == info["decide_ticks"]
+    assert all(len(e.slots) == 1 for e in decided)
+    assert int(np.asarray(final.member).sum()) == n - info["instances"]

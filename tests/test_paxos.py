@@ -5,7 +5,7 @@ import random
 
 import pytest
 
-from rapid_tpu.oracle.paxos import FastPaxos, Paxos
+from rapid_tpu.oracle.paxos import FastPaxos, Paxos, classic_rank_node_index
 from rapid_tpu.oracle.testkit import (
     DirectBroadcaster,
     DirectMessagingClient,
@@ -13,7 +13,15 @@ from rapid_tpu.oracle.testkit import (
     NoOpBroadcaster,
     NoOpClient,
 )
-from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage, Phase1bMessage, Rank
+from rapid_tpu.types import (
+    Endpoint,
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    Rank,
+)
 
 MAX_INT = 2**31 - 1
 
@@ -284,6 +292,107 @@ def test_fast_quorum_with_conflicts(n, quorum, conflicts, change_expected):
     assert (decided == [list(proposal)]) == change_expected
     # stale-configuration and duplicate-sender votes are ignored
     fp.handle_messages(FastRoundPhase2bMessage(Endpoint("127.0.0.3", 999), 2, proposal))
+
+
+# ---------------------------------------------------------------------------
+# stale configurations, duplicate decisions, rank ordering
+# ---------------------------------------------------------------------------
+
+
+class _RecordingClient(NoOpClient):
+    def __init__(self):
+        self.sent = []
+
+    def send_message(self, remote, request, on_response=None):
+        self.sent.append((remote, request))
+
+
+class _RecordingBroadcaster(NoOpBroadcaster):
+    def __init__(self):
+        self.broadcasts = []
+
+    def broadcast(self, request):
+        self.broadcasts.append(request)
+
+
+def test_stale_configuration_phase1b_replies_are_ignored():
+    """Phase-1b replies from an older configuration must not count toward
+    the coordinator's majority or trigger phase 2a."""
+    client = _RecordingClient()
+    bcast = _RecordingBroadcaster()
+    paxos = Paxos(Endpoint("127.0.0.1", 1234), 1, 3, client, bcast,
+                  lambda _: None)
+    paxos.start_phase1a(2)
+    crnd = paxos._crnd
+    for i in range(3):
+        paxos.handle_phase1b(Phase1bMessage(
+            Endpoint("127.0.0.2", i), 7, rnd=crnd, vrnd=Rank(1, 1), vval=P1))
+    assert paxos._phase1b_messages == {}
+    assert [type(b) for b in bcast.broadcasts] == [Phase1aMessage]
+    # the same replies at the current configuration do complete phase 1
+    for i in range(3):
+        paxos.handle_phase1b(Phase1bMessage(
+            Endpoint("127.0.0.2", i), 1, rnd=crnd, vrnd=Rank(1, 1), vval=P1))
+    assert paxos._cval == P1
+    assert type(bcast.broadcasts[-1]) is Phase2aMessage
+
+
+def test_stale_configuration_1a_2a_2b_are_ignored():
+    """The acceptor/learner handlers filter on configuration id without
+    mutating any state or replying."""
+    decided = []
+    client = _RecordingClient()
+    paxos = Paxos(Endpoint("127.0.0.1", 1234), 1, 3, client,
+                  _RecordingBroadcaster(), decided.append)
+    sender = Endpoint("127.0.0.2", 1)
+    rank = Rank(2, 99)
+    paxos.handle_phase1a(Phase1aMessage(sender, 7, rank))
+    assert client.sent == [] and paxos._rnd == Rank(0, 0)
+    paxos.handle_phase2a(Phase2aMessage(sender, 7, rnd=rank, vval=P1))
+    assert paxos._vrnd == Rank(0, 0) and paxos._vval == ()
+    for i in range(3):
+        paxos.handle_phase2b(Phase2bMessage(
+            Endpoint("127.0.0.2", i), 7, rnd=rank, endpoints=P1))
+    assert decided == [] and paxos._accept_responses == {}
+
+
+def test_classic_majority_after_fast_decision_is_ignored():
+    """A classic phase-2b majority landing after the fast round already
+    decided hits the idempotent decision funnel (_on_decided_wrapped):
+    one external decision, no re-fire."""
+    decided = []
+    fp = _fast_paxos_single(5, decided.append)
+    proposal = hosts("127.0.0.1:1235")
+    for i in range(4):  # quorum = 5 - 1
+        fp.handle_messages(
+            FastRoundPhase2bMessage(Endpoint("127.0.0.2", i), 1, proposal))
+    assert decided == [list(proposal)]
+    rank = Rank(2, 7)
+    for i in range(3):  # classic majority for a different value
+        fp.handle_messages(Phase2bMessage(
+            Endpoint("127.0.0.3", i), 1, rnd=rank, endpoints=P2))
+    assert decided == [list(proposal)]
+
+
+def test_rank_tie_breaking_across_node_indices():
+    """Competing round-2 coordinators order by classic_rank_node_index: an
+    acceptor re-promises only to the higher-indexed rank, and the losing
+    coordinator's retries bounce off the promise."""
+    a, b = Endpoint("127.0.0.1", 5891), Endpoint("127.0.0.1", 5821)
+    ia, ib = classic_rank_node_index(a), classic_rank_node_index(b)
+    assert ia != ib
+    (low, li), (high, hi) = sorted(((a, ia), (b, ib)), key=lambda t: t[1])
+    client = _RecordingClient()
+    acceptor = Paxos(Endpoint("127.0.0.1", 1), 1, 3, client,
+                     _RecordingBroadcaster(), lambda _: None)
+    acceptor.handle_phase1a(Phase1aMessage(low, 1, Rank(2, li)))
+    assert [r for r, _ in client.sent] == [low]
+    acceptor.handle_phase1a(Phase1aMessage(high, 1, Rank(2, hi)))
+    assert [r for r, _ in client.sent] == [low, high]
+    assert acceptor._rnd == Rank(2, hi)
+    acceptor.handle_phase1a(Phase1aMessage(low, 1, Rank(2, li)))
+    assert [r for r, _ in client.sent] == [low, high]
+    assert acceptor._rnd == Rank(2, hi)
 
 
 def test_straggler_fallback_after_fast_decision_is_idempotent():

@@ -6,13 +6,20 @@ engine tick benchmark three times — an N=1k steady crash-burst, an N=1k
 sustained-churn run, and an N=1k contested-consensus run through the
 classic-Paxos fallback kernel — with defaults small enough to finish
 quickly on CPU, and emits a single ``engine_tick_suite`` JSON payload.
-When writing to stdout the payload is one compact line (the *last*
-line, so harnesses that parse the stdout tail always get the whole
-object); ``--out FILE`` writes the indented form. Each sub-payload
+
+The stdout payload is always one compact *summary-only* line (the last
+line, explicitly flushed, so harnesses that parse the stdout tail always
+get the whole object): the per-view-change row lists are elided down to
+a ``view_changes_elided`` count, keeping the line small no matter how
+many view changes the run decided. The full payload — per-view-change
+rows included — goes to ``--out FILE`` (indented). Each sub-payload
 carries the per-run protocol summary in its ``telemetry`` block
-(``rapid_tpu.telemetry.metrics.RunSummary``), validatable with::
+(``rapid_tpu.telemetry.metrics.RunSummary``); both forms validate with::
 
     python -m rapid_tpu.telemetry.schema BENCH.json
+
+``scripts/bench_compare.py`` diffs the ``--out`` artifact against the
+committed ``benchmarks/baseline.json`` regression baseline.
 
 For sweeps, tracing, and scenario knobs use the full benchmark:
 ``python benchmarks/bench_engine.py --help``.
@@ -31,6 +38,26 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from benchmarks.bench_engine import run, run_churn, run_contested  # noqa: E402
 
 
+def _compact_payload(payload: dict) -> dict:
+    """Summary-only form for the stdout line.
+
+    The per-view-change rows are the only unbounded part of the payload
+    (one record per decided proposal); eliding them — with an explicit
+    ``view_changes_elided`` count so their absence is visible — keeps the
+    last stdout line compact for tail-capture harnesses. The ``--out``
+    artifact keeps the full rows.
+    """
+    out = dict(payload)
+    for key in ("steady", "churn", "contested"):
+        run_p = dict(out[key])
+        tel = dict(run_p["telemetry"])
+        tel["view_changes_elided"] = len(tel.get("view_changes") or [])
+        tel["view_changes"] = []
+        run_p["telemetry"] = tel
+        out[key] = run_p
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--n", type=int, default=1_000,
@@ -47,10 +74,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     from rapid_tpu.settings import Settings
+    from rapid_tpu.telemetry.schema import SCHEMA_VERSION
 
     settings = Settings()
     payload = {
         "bench": "engine_tick_suite",
+        "schema_version": SCHEMA_VERSION,
         "n": args.n,
         "ticks": args.ticks,
         "steady": run(args.n, args.ticks, crash_frac=0.01, crash_tick=5,
@@ -62,8 +91,11 @@ def main(argv=None) -> int:
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(json.dumps(payload, indent=2) + "\n")
-    else:
-        sys.stdout.write(json.dumps(payload) + "\n")
+    # The compact summary line always goes to stdout (flushed) so the
+    # harness's tail-capture works whether or not --out was given.
+    sys.stdout.write(
+        json.dumps(_compact_payload(payload), separators=(",", ":")) + "\n")
+    sys.stdout.flush()
     return 0
 
 

@@ -55,6 +55,12 @@ def _telemetry_block(logs) -> dict:
     return summarize(engine_metrics(logs)).as_dict()
 
 
+def _schema_version() -> int:
+    from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+    return SCHEMA_VERSION
+
+
 def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
         settings, seed: int = 0, trace_writer=None) -> dict:
     import jax
@@ -98,6 +104,7 @@ def run(n: int, ticks: int, crash_frac: float, crash_tick: int,
     ticks_per_sec = ticks / wall_s
     return {
         "bench": "engine_tick",
+        "schema_version": _schema_version(),
         "platform": jax.default_backend(),
         "n": n,
         "k": settings.K,
@@ -172,6 +179,7 @@ def run_churn(n: int, ticks: int, burst: int, settings, seed: int = 0,
     ticks_per_sec = ticks / wall_s
     return {
         "bench": "engine_tick",
+        "schema_version": _schema_version(),
         "scenario": "churn",
         "platform": jax.default_backend(),
         "n": n,
@@ -240,6 +248,7 @@ def run_contested(n: int, ticks: int, settings, seed: int = 0,
     ticks_per_sec = ticks / wall_s
     return {
         "bench": "engine_tick",
+        "schema_version": _schema_version(),
         "scenario": "contested",
         "platform": jax.default_backend(),
         "n": n,
@@ -285,6 +294,17 @@ def main(argv=None) -> int:
                              "stdout)")
     parser.add_argument("--sweep", action="store_true",
                         help="run the BASELINE sweep n in {1k, 10k, 100k}")
+    parser.add_argument("--profile-sweep", action="store_true",
+                        help="per-kernel cost observatory: lower each tick "
+                             "sub-kernel separately and emit the dominance "
+                             "report (rapid_tpu.telemetry.profile)")
+    parser.add_argument("--profile-sizes", type=int, nargs="+",
+                        default=[1_000, 10_000, 100_000], metavar="N",
+                        help="cluster sizes for --profile-sweep "
+                             "(default 1k 10k 100k)")
+    parser.add_argument("--profile-repeats", type=int, default=5,
+                        help="timed dispatches per kernel in "
+                             "--profile-sweep (default 5)")
     parser.add_argument("--trace", type=str, default=None, metavar="FILE",
                         help="write a Chrome/Perfetto trace-event JSON of "
                              "the measured run (open at ui.perfetto.dev)")
@@ -301,6 +321,20 @@ def main(argv=None) -> int:
     from rapid_tpu.telemetry.trace import TraceWriter, jax_profiler_trace
 
     settings = Settings(K=args.k)
+
+    if args.profile_sweep:
+        from rapid_tpu.telemetry.profile import dominance_report
+
+        report = dominance_report(args.profile_sizes, settings,
+                                  repeats=args.profile_repeats,
+                                  seed=args.seed)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(json.dumps(report, indent=2) + "\n")
+        else:
+            sys.stdout.write(json.dumps(report) + "\n")
+            sys.stdout.flush()
+        return 0
     writer = TraceWriter() if args.trace else None
     sizes = [1_000, 10_000, 100_000] if args.sweep else [args.n]
     with jax_profiler_trace(args.jax_profile):
@@ -316,8 +350,10 @@ def main(argv=None) -> int:
             results = [run(n, args.ticks, args.crash_frac, args.crash_tick,
                            settings, args.seed, trace_writer=writer)
                        for n in sizes]
-    payload = results[0] if len(results) == 1 else {"bench": "engine_tick",
-                                                    "sweep": results}
+    payload = results[0] if len(results) == 1 else {
+        "bench": "engine_tick",
+        "schema_version": _schema_version(),
+        "sweep": results}
     if writer is not None:
         writer.write(args.trace)
         payload["trace"] = args.trace

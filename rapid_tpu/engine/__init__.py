@@ -18,6 +18,13 @@ from rapid_tpu.engine.churn import (
     plan_churn,
     synthetic_churn_schedule,
 )
+from rapid_tpu.engine.invariants import (
+    INVARIANT_BITS,
+    InvariantViolationError,
+    check_run,
+    check_step,
+    describe_bits,
+)
 from rapid_tpu.engine.state import (
     EngineFaults,
     EngineState,
@@ -40,8 +47,13 @@ __all__ = [
     "ChurnSchedule",
     "EngineFaults",
     "EngineState",
+    "INVARIANT_BITS",
+    "InvariantViolationError",
     "StepLog",
     "build_topology",
+    "check_run",
+    "check_step",
+    "describe_bits",
     "empty_schedule",
     "engine_step",
     "init_state",

@@ -1,0 +1,217 @@
+"""On-device protocol invariant monitor for the batched tick engine.
+
+The differential harness (``rapid_tpu.engine.diff``) catches divergence
+from the oracle, but only for scenarios the oracle can replay. This
+module checks the protocol's *internal* invariants on-device, every tick,
+inside the jitted step — so corruption is caught at the tick it happens
+even in oracle-free runs (benchmarks, sweeps, future pjit shards):
+
+- **ring_degree** — the K-ring topology is well formed: every member
+  row's subjects and observers are members (and not the node itself once
+  the view has >= 2 members); every dormant row self-points;
+- **report_monotone** — cut-detector report cells only ever fill within
+  a configuration; the only thing that clears them is a decided view
+  change (``MultiNodeCutDetector`` has no report-retraction path);
+- **unique_decide** — at most one decided proposal per configuration
+  epoch: the fast round and the classic chain never both claim the same
+  tick, a decision always carries a non-empty proposal mask, and a fast
+  quorum can only form for a proposal that was actually announced;
+- **rank_order** — classic-Paxos rank sanity per slot: an accepted-vote
+  rank never exceeds the promised rank (``vrnd <= rnd``), a non-zero
+  ``vrnd`` carries a value, and a chosen coordinator value implies a
+  started round (mirrors ``oracle/paxos.py``'s Rank ordering);
+- **epoch_monotone** — the configuration epoch advances by exactly the
+  number of decisions this tick (one), and never regresses;
+- **memsum** — the incremental membership-fingerprint sum (limb-added /
+  subtracted on view changes) still equals the sum recomputed from the
+  member mask, so configuration ids cannot silently drift.
+
+Each check folds to one boolean; ``check_step`` packs them into an
+``int32`` bitmask logged per tick in ``StepLog.inv_bits`` and surfaced as
+the ``invariant_violations`` telemetry gauge. The monitor is compiled in
+only when ``Settings.invariant_checks`` is True (a static jit argument):
+with the flag off, the step never calls into this module and its jaxpr is
+unchanged — zero overhead.
+
+Host side, ``check_run`` scans a run's stacked logs and escalates the
+first violating tick as an ``InvariantViolationError`` — a
+``telemetry.forensics.DivergenceError`` whose report names the tick, the
+decoded invariant names, and every violating tick as context (optionally
+written as a JSONL artifact, ``RAPID_TPU_FORENSICS``-style).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rapid_tpu import hashing
+from rapid_tpu.engine.paxos import rank_lt
+from rapid_tpu.telemetry.forensics import DivergenceError, DivergenceReport
+
+#: Violation bit registry, in bit order. The bit assignment is part of
+#: the telemetry contract (logged bitmasks persist in BENCH artifacts),
+#: so bits are append-only: never renumber an existing invariant.
+INVARIANT_BITS = (
+    ("ring_degree", 0),
+    ("report_monotone", 1),
+    ("unique_decide", 2),
+    ("rank_order", 3),
+    ("epoch_monotone", 4),
+    ("memsum", 5),
+)
+
+BIT_OF = {name: bit for name, bit in INVARIANT_BITS}
+ALL_BITS = sum(1 << bit for _, bit in INVARIANT_BITS)
+
+
+def describe_bits(mask: int) -> List[str]:
+    """Decode a violation bitmask into invariant names (bit order)."""
+    return [name for name, bit in INVARIANT_BITS if (mask >> bit) & 1]
+
+
+# ---------------------------------------------------------------------------
+# per-invariant device checks (each returns a traced boolean scalar)
+# ---------------------------------------------------------------------------
+
+
+def _ring_degree(xp, post) -> object:
+    """K-ring well-formedness on the post-tick topology.
+
+    ``build_topology`` guarantees member rows point at member slots (and,
+    with >= 2 members, never at themselves — each ring is a single cycle
+    over the members) and that dormant rows self-point in both
+    directions. Any index escaping those sets means the topology arrays
+    were corrupted after the last rebuild.
+    """
+    c = post.member.shape[0]
+    slots = xp.arange(c, dtype=xp.int32)[:, None]
+    m_rows = post.member[:, None]
+    multi = post.member.sum() >= 2
+    bad_member = m_rows & (
+        ~post.member[post.subj_idx]
+        | ~post.member[post.obs_idx]
+        | (multi & ((post.subj_idx == slots) | (post.obs_idx == slots))))
+    bad_dormant = ~m_rows & ((post.subj_idx != slots)
+                             | (post.obs_idx != slots))
+    return (bad_member | bad_dormant).any()
+
+
+def _rank_order(xp, post) -> object:
+    """Classic-Paxos per-slot rank sanity (oracle Rank lexicographic
+    order): vrnd <= rnd always, vrnd > 0 carries a value, and a chosen
+    coordinator value implies the coordinator started a round."""
+    bad = rank_lt(post.px_rnd_r, post.px_rnd_i,
+                  post.px_vrnd_r, post.px_vrnd_i)
+    bad = bad | ((post.px_vrnd_r > 0) & (post.px_vval < 0))
+    bad = bad | ((post.px_cval >= 0) & (post.px_crnd_r <= 0))
+    return bad.any()
+
+
+def _memsum(xp, post) -> object:
+    """The incremental member-fingerprint sum must equal the sum
+    recomputed from scratch over the member mask (catches member-bit or
+    limb-arithmetic corruption that would shift every config id)."""
+    m = post.member.astype(xp.uint32)
+    hi, lo = hashing.sum64(xp, post.mfp_hi * m, post.mfp_lo * m)
+    return (hi != post.memsum_hi) | (lo != post.memsum_lo)
+
+
+def check_step(xp, pre, post, *, decide_now, fast_decide, classic_decide,
+               fast_mask, classic_mask):
+    """All invariant checks for one tick, packed into an i32 bitmask.
+
+    ``pre``/``post`` are the EngineState before and after the tick;
+    ``fast_decide``/``classic_decide`` are this tick's decision sources
+    with ``fast_mask``/``classic_mask`` their proposal masks (the step
+    passes the pre-tick announced proposal and the schedule's classic
+    mask). Returns 0 when every invariant holds.
+    """
+    win_mask = xp.where(classic_decide, classic_mask, fast_mask)
+    flags = {
+        "ring_degree": _ring_degree(xp, post),
+        "report_monotone": ~decide_now & (pre.reports
+                                          & ~post.reports).any(),
+        "unique_decide": ((fast_decide & classic_decide)
+                          | (decide_now & ~win_mask.any())
+                          | (fast_decide & ~pre.announced)),
+        "rank_order": _rank_order(xp, post),
+        "epoch_monotone": post.epoch != pre.epoch
+        + decide_now.astype(xp.int32),
+        "memsum": _memsum(xp, post),
+    }
+    bits = xp.int32(0)
+    for name, bit in INVARIANT_BITS:
+        bits = bits | (flags[name].astype(xp.int32) << bit)
+    return bits
+
+
+# ---------------------------------------------------------------------------
+# host-side escalation
+# ---------------------------------------------------------------------------
+
+
+def expand_violations(logs) -> List[Tuple[int, int, List[str]]]:
+    """Nonzero violation rows of a stacked run log, as
+    ``(tick, bitmask, [invariant names])`` in tick order."""
+    ticks = np.asarray(logs.tick)
+    bits = np.asarray(logs.inv_bits)
+    out: List[Tuple[int, int, List[str]]] = []
+    for i in range(len(bits)):
+        b = int(bits[i])
+        if b:
+            out.append((int(ticks[i]), b, describe_bits(b)))
+    return out
+
+
+class InvariantViolationError(DivergenceError):
+    """An on-device invariant check fired; ``report`` names the first
+    violating tick and the decoded invariants (still an AssertionError,
+    like every forensics escalation)."""
+
+    def __init__(self, report: DivergenceReport,
+                 artifact: Optional[str] = None) -> None:
+        self.report = report
+        self.artifact = artifact
+        lines = [f"on-device invariant monitor fired at tick "
+                 f"{report.tick}: {report.field} "
+                 f"(bitmask {report.engine:#x})"]
+        for rec in report.context:
+            if rec.get("record") == "invariant_violation":
+                lines.append(f"  tick {rec['tick']}: "
+                             f"{'+'.join(rec['invariants'])} "
+                             f"(bits {rec['bits']:#x})")
+        if artifact:
+            lines.append(f"forensics artifact: {artifact}")
+        AssertionError.__init__(self, "\n".join(lines))
+
+
+def check_run(logs, metrics: Optional[Sequence] = None,
+              artifact: Optional[str] = None,
+              context_n: int = 16) -> None:
+    """Escalate a run's logged violations; no-op on a clean run.
+
+    Raises ``InvariantViolationError`` naming the first violating tick
+    and its invariants, with up to ``context_n`` violating ticks (and,
+    when ``metrics`` is given, the trailing ``TickMetrics`` rows before
+    the first violation) as report context. ``artifact`` — or the
+    ``RAPID_TPU_FORENSICS`` env var — writes the report as JSONL.
+    """
+    violations = expand_violations(logs)
+    if not violations:
+        return
+    tick, bits, names = violations[0]
+    context = []
+    if metrics:
+        context += [dict(m.as_dict(), record="tick_metrics")
+                    for m in metrics if m.tick <= tick][-4:]
+    context += [{"record": "invariant_violation", "tick": t, "bits": b,
+                 "invariants": ns} for t, b, ns in violations[:context_n]]
+    report = DivergenceReport(
+        tick=tick, field="invariants." + "+".join(names),
+        engine=bits, oracle=0, context=context)
+    artifact = artifact or os.environ.get("RAPID_TPU_FORENSICS")
+    if artifact:
+        report.write_jsonl(artifact)
+    raise InvariantViolationError(report, artifact)

@@ -228,6 +228,9 @@ class StepLog(NamedTuple):
     px2b_recipients: object           # i32
     px_timers_armed: object           # i32 gauge: armed fallback timers
     px_coord_round: object            # i32 gauge: max classic round started
+    # --- on-device invariant monitor (rapid_tpu.engine.invariants) ------
+    inv_bits: object                  # i32: violation bitmask (0 = clean;
+                                      # constant 0 when the monitor is off)
 
 
 def config_id_limbs(xp, idsum_hi, idsum_lo, memsum_hi, memsum_lo):

@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from rapid_tpu import hashing
-from rapid_tpu.engine import cut, monitor
+from rapid_tpu.engine import cut, invariants, monitor
 from rapid_tpu.engine import paxos as paxos_mod
 from rapid_tpu.engine import votes as votes_mod
 from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
@@ -304,6 +304,21 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
             "px2a_recipients", "px2b_senders", "px2b_recipients")}
         px_timers_armed = px_coord_round = zero
 
+    # ---- on-device invariant monitor (static flag; see engine.invariants)
+    # Module-attribute call so tests can monkeypatch a spy and prove the
+    # disabled path never traces a single check op.
+    if settings.invariant_checks:
+        inv_bits = invariants.check_step(
+            jnp, state, new_state,
+            decide_now=decide_now,
+            fast_decide=alert_decide,
+            classic_decide=sc_decide,
+            fast_mask=state.proposal,
+            classic_mask=sc_mask,
+        )
+    else:
+        inv_bits = jnp.int32(0)
+
     cfg_hi, cfg_lo = config_id_limbs(
         jnp, new_state.idsum_hi, new_state.idsum_lo,
         new_state.memsum_hi, new_state.memsum_lo)
@@ -349,6 +364,7 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         px2b_recipients=px_counts["px2b_recipients"],
         px_timers_armed=px_timers_armed,
         px_coord_round=px_coord_round,
+        inv_bits=inv_bits,
     )
     return new_state, log
 

@@ -52,6 +52,12 @@ class Settings:
     max_cut_size: int = 64      # max nodes per view-change proposal
     max_active_dsts: int = 128  # alert destinations tracked per config
 
+    # --- observability (rapid_tpu.engine.invariants) ---
+    # Compile the on-device protocol invariant monitor into the jitted
+    # step. Static: flipping it retraces; False compiles the checks out
+    # entirely, so the production step pays nothing for them.
+    invariant_checks: bool = False
+
     # --- randomness ---
     seed: int = 0
 

@@ -56,6 +56,11 @@ class TickMetrics:
     vote_tally: int = UNOBSERVED
     quorum: int = UNOBSERVED
     churn_injected: int = UNOBSERVED
+    # on-device invariant-monitor bitmask (engine.invariants.describe_bits
+    # decodes it); 0 on every clean tick, constant 0 when the run was
+    # compiled with Settings.invariant_checks=False, UNOBSERVED on the
+    # oracle.
+    invariant_violations: int = UNOBSERVED
     # consensus-fallback gauges (engine-derived; UNOBSERVED on the oracle
     # and whenever the run has no fallback schedule). The per-phase sent
     # gauges are *not* counters: the oracle's alert-path fast votes land
@@ -117,6 +122,7 @@ def engine_metrics(logs) -> List[TickMetrics]:
     tally = np.asarray(logs.vote_tally)
     quorum = np.asarray(logs.quorum)
     churned = np.asarray(logs.churn_injected)
+    inv_bits = np.asarray(logs.inv_bits)
     timers_armed = np.asarray(logs.px_timers_armed)
     coord_round = np.asarray(logs.px_coord_round)
 
@@ -132,6 +138,7 @@ def engine_metrics(logs) -> List[TickMetrics]:
             vote_tally=int(tally[i]),
             quorum=int(quorum[i]),
             churn_injected=int(churned[i]),
+            invariant_violations=int(inv_bits[i]),
             px_timers_armed=int(timers_armed[i]),
             px_coord_round=int(coord_round[i]),
             px_fast_vote_sent=px[i]["fast_vote_sent"],
@@ -216,6 +223,10 @@ class RunSummary:
     total_timeouts: int
     total_probes_sent: int
     total_probes_failed: int
+    # ticks whose on-device invariant bitmask was nonzero (0 on clean
+    # runs and whenever the monitor was compiled out; UNOBSERVED gauges
+    # are excluded from the count).
+    invariant_violations: int = 0
     # consensus-fallback traffic totals per phase (fast_vote, phase1a,
     # phase1b, phase2a, phase2b); all-zero when the run had no fallback
     # schedule (UNOBSERVED gauges are excluded from the sums).
@@ -244,8 +255,11 @@ def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
                  ("phase2a", "px_phase2a_sent"),
                  ("phase2b", "px_phase2b_sent"))
     px_totals = {phase: 0 for phase, _ in px_fields}
+    inv_ticks = 0
 
     for m in metrics:
+        if m.invariant_violations > 0:
+            inv_ticks += 1
         for f in COUNTER_FIELDS:
             totals[f] += getattr(m, f)
         for phase, attr in px_fields:
@@ -294,5 +308,6 @@ def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
         total_timeouts=totals["timeouts"],
         total_probes_sent=totals["probes_sent"],
         total_probes_failed=totals["probes_failed"],
+        invariant_violations=inv_ticks,
         fallback_phase_sent=px_totals,
     )

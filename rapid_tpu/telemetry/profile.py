@@ -1,0 +1,362 @@
+"""Per-kernel cost observatory: where does a tick's work actually go?
+
+The ROADMAP's pjit-sharding item is gated on "timings showing which
+kernel dominates at 100k". This module answers that question by lowering
+each sub-kernel of the tick *separately* — topology rebuild, failure-
+detector monitor, cut delivery + aggregation, fast-round vote count, and
+each classic-Paxos phase (chain delivery, fast tally, phase-1a delivery,
+task phase) — plus the full composed step as a reference, and reporting
+for each one:
+
+- XLA static cost analysis (``Compiled.cost_analysis()``): FLOPs and
+  bytes accessed;
+- XLA memory analysis (``Compiled.memory_analysis()``): argument /
+  output / temp sizes and the derived peak working-set bound;
+- measured wall clock: compile time plus best/median dispatch time over
+  ``repeats`` timed calls of the pre-compiled executable (AOT, so the
+  timings exclude tracing and cache lookups).
+
+``dominance_report`` sweeps N (default 1k/10k/100k) and emits the
+"kernel_profile_sweep" JSON payload — ``dominant_by_n`` names the
+wall-clock-dominant kernel per N, ``runs[*].dominant`` additionally
+names the FLOPs- and bytes-dominant kernels. The payload validates via
+``rapid_tpu.telemetry.schema`` and is produced by::
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_engine.py --profile-sweep
+    JAX_PLATFORMS=cpu python -m rapid_tpu.telemetry.profile --sizes 1000
+
+The profiled state is a mid-protocol snapshot (a seeded crash burst
+warmed up a few ticks), so the kernels see realistic occupancy rather
+than all-zero buffers.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import statistics
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+#: Kernel names in report order; ``full_step`` is the composed reference
+#: and never picked as dominant.
+KERNEL_ORDER = (
+    "topology_rebuild",
+    "monitor",
+    "cut_aggregate",
+    "vote_count",
+    "paxos_chain_deliver",
+    "paxos_fast_tally",
+    "paxos_phase1a_deliver",
+    "paxos_task_phase",
+    "full_step",
+)
+
+
+@dataclass(frozen=True)
+class KernelCost:
+    """One kernel's static + measured cost at one N."""
+
+    kernel: str
+    flops: float
+    bytes_accessed: float
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    peak_bytes: int
+    compile_s: float
+    wall_median_s: float
+    wall_best_s: float
+    repeats: int
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def synthetic_state(n: int, settings, seed: int = 0,
+                    warmup_ticks: int = 8, crash_frac: float = 0.01,
+                    crash_tick: int = 5):
+    """A mid-protocol (state, faults) pair at size ``n``.
+
+    Same synthetic identities as ``benchmarks/bench_engine.py``; a seeded
+    crash burst plus ``warmup_ticks`` of simulation leave the monitor
+    counters, alert pipeline, and cut detector realistically occupied.
+    """
+    import jax
+
+    from rapid_tpu import hashing
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.step import simulate
+
+    hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF ^ (seed & 0xFFFF))
+    uids = hashing.np_from_limbs(hi, lo)
+
+    state = init_state(uids, id_fp_sum=0, settings=settings)
+    n_crash = max(1, int(n * crash_frac))
+    crash_ticks = [I32_MAX] * n
+    for slot in range(0, n, max(1, n // n_crash)):
+        crash_ticks[slot] = crash_tick
+    faults = crash_faults(crash_ticks)
+    if warmup_ticks > 0:
+        state, _ = simulate(state, faults, warmup_ticks, settings)
+    jax.block_until_ready(state)
+    return state, faults
+
+
+def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
+    """(name, fn, args) for each separately-lowered sub-kernel.
+
+    The closures mirror the call sites in ``engine/step.py`` exactly
+    (same operand shapes, same derived scalars), so the per-kernel costs
+    add up to the composed step's profile.
+    """
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine import cut, monitor
+    from rapid_tpu.engine import paxos as paxos_mod
+    from rapid_tpu.engine import votes as votes_mod
+    from rapid_tpu.engine.step import step as step_fn
+    from rapid_tpu.engine.topology import build_topology
+
+    k = settings.K
+
+    def topology_rebuild(uid_hi, uid_lo, member):
+        return build_topology(jnp, uid_hi, uid_lo, member, k)
+
+    def monitor_kernel(state, faults):
+        return monitor.monitor_tick(jnp, state, faults, settings)
+
+    def cut_aggregate(state, faults):
+        crashed = monitor.crashed_at(faults, state.tick + 1)
+        src_alive = ~crashed
+        delivered_down = cut.deliver_reports(jnp, state, src_alive)
+        delivered_up = jnp.zeros_like(delivered_down)
+        any_recv = (state.member & ~crashed).any()
+        return cut.aggregate(jnp, state, delivered_down, delivered_up,
+                             any_recv, settings)
+
+    def vote_count(state, faults):
+        crashed = monitor.crashed_at(faults, state.tick + 1)
+        c = state.member.shape[0]
+        n_member = state.member.sum().astype(jnp.int32)
+        valid = state.voters & ~crashed & state.vote_pending
+        return votes_mod.count_fast_round(
+            jnp,
+            jnp.broadcast_to(state.phash_hi, (c,)),
+            jnp.broadcast_to(state.phash_lo, (c,)),
+            valid, n_member)
+
+    cases = [
+        ("topology_rebuild", topology_rebuild,
+         (state.uid_hi, state.uid_lo, state.member)),
+        ("monitor", monitor_kernel, (state, faults)),
+        ("cut_aggregate", cut_aggregate, (state, faults)),
+        ("vote_count", vote_count, (state, faults)),
+    ]
+
+    if fallback is not None:
+        false_ = jnp.asarray(False)
+
+        def paxos_chain_deliver(state, sched):
+            n_member = state.member.sum().astype(jnp.int32)
+            return paxos_mod.chain_deliver(jnp, state, sched,
+                                           state.tick + 1, n_member)
+
+        def paxos_fast_tally(state, sched):
+            n_member = state.member.sum().astype(jnp.int32)
+            return paxos_mod.fast_tally(jnp, state, sched, state.tick + 1,
+                                        n_member, false_)
+
+        def paxos_phase1a_deliver(state, sched):
+            n_member = state.member.sum().astype(jnp.int32)
+            return paxos_mod.phase1a_deliver(jnp, state, sched,
+                                             state.tick + 1, n_member,
+                                             false_)
+
+        def paxos_task_phase(state, sched):
+            n_member = state.member.sum().astype(jnp.int32)
+            return paxos_mod.task_phase(jnp, state, sched, state.tick + 1,
+                                        n_member, false_)
+
+        cases += [
+            ("paxos_chain_deliver", paxos_chain_deliver, (state, fallback)),
+            ("paxos_fast_tally", paxos_fast_tally, (state, fallback)),
+            ("paxos_phase1a_deliver", paxos_phase1a_deliver,
+             (state, fallback)),
+            ("paxos_task_phase", paxos_task_phase, (state, fallback)),
+        ]
+
+        def full_step(state, faults, sched):
+            return step_fn(state, faults, settings, None, sched)
+
+        cases.append(("full_step", full_step, (state, faults, fallback)))
+    else:
+        def full_step(state, faults):
+            return step_fn(state, faults, settings)
+
+        cases.append(("full_step", full_step, (state, faults)))
+    return cases
+
+
+def _cost_entry(compiled) -> Dict[str, float]:
+    """Normalize ``Compiled.cost_analysis()`` across jax versions (a dict
+    on some, a one-element list of dicts on others)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost if isinstance(cost, dict) else {}
+
+
+def _memory_stats(compiled) -> Dict[str, int]:
+    out = {"argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
+           "peak_bytes": 0}
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return out
+    if mem is None:
+        return out
+    arg = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+    res = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+    tmp = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+    alias = int(getattr(mem, "alias_size_in_bytes", 0) or 0)
+    out.update(argument_bytes=arg, output_bytes=res, temp_bytes=tmp,
+               peak_bytes=arg + res + tmp - alias)
+    return out
+
+
+def measure_kernel(name: str, fn, args, repeats: int = 5) -> KernelCost:
+    """AOT-lower one kernel, read its XLA analyses, time its dispatch."""
+    import jax
+
+    t0 = time.perf_counter()
+    compiled = jax.jit(fn).lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+
+    cost = _cost_entry(compiled)
+    mem = _memory_stats(compiled)
+
+    jax.block_until_ready(compiled(*args))  # warm the allocator
+    times: List[float] = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*args))
+        times.append(time.perf_counter() - t0)
+
+    return KernelCost(
+        kernel=name,
+        flops=float(cost.get("flops", 0.0)),
+        bytes_accessed=float(cost.get("bytes accessed", 0.0)),
+        argument_bytes=mem["argument_bytes"],
+        output_bytes=mem["output_bytes"],
+        temp_bytes=mem["temp_bytes"],
+        peak_bytes=mem["peak_bytes"],
+        compile_s=round(compile_s, 6),
+        wall_median_s=round(statistics.median(times), 9),
+        wall_best_s=round(min(times), 9),
+        repeats=len(times),
+    )
+
+
+def profile_kernels(n: int, settings, repeats: int = 5, seed: int = 0,
+                    warmup_ticks: int = 8,
+                    include_fallback: bool = True) -> Dict[str, object]:
+    """Profile every sub-kernel at size ``n``; returns one report entry."""
+    from rapid_tpu.engine.paxos import empty_fallback_schedule
+
+    state, faults = synthetic_state(n, settings, seed=seed,
+                                    warmup_ticks=warmup_ticks)
+    c = int(state.member.shape[0])
+    fallback = empty_fallback_schedule(c) if include_fallback else None
+    costs = [measure_kernel(name, fn, args, repeats=repeats)
+             for name, fn, args in kernel_cases(state, faults, settings,
+                                                fallback)]
+    sub = [k for k in costs if k.kernel != "full_step"]
+    dominant = {
+        "wall_clock": max(sub, key=lambda k: k.wall_median_s).kernel,
+        "flops": max(sub, key=lambda k: k.flops).kernel,
+        "bytes": max(sub, key=lambda k: k.bytes_accessed).kernel,
+    }
+    full = next(k for k in costs if k.kernel == "full_step")
+    sub_wall = sum(k.wall_median_s for k in sub)
+    return {
+        "n": n,
+        "capacity": c,
+        "warmup_ticks": warmup_ticks,
+        "kernels": [k.as_dict() for k in costs],
+        "dominant": dominant,
+        # How much of the composed step the sub-kernels account for:
+        # < 1 means glue (view-change cond, log assembly) matters too.
+        "subkernel_wall_fraction": round(
+            sub_wall / full.wall_median_s, 3) if full.wall_median_s else None,
+    }
+
+
+def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
+                     seed: int = 0, warmup_ticks: int = 8,
+                     include_fallback: bool = True) -> Dict[str, object]:
+    """The ``--profile-sweep`` artifact: per-N kernel costs plus the
+    wall-clock-dominant kernel per N (the pjit-sharding gate input)."""
+    import jax
+
+    runs = [profile_kernels(n, settings, repeats=repeats, seed=seed,
+                            warmup_ticks=warmup_ticks,
+                            include_fallback=include_fallback)
+            for n in sizes]
+    return {
+        "bench": "kernel_profile_sweep",
+        "schema_version": SCHEMA_VERSION,
+        "platform": jax.default_backend(),
+        "k": settings.K,
+        "sizes": list(sizes),
+        "runs": runs,
+        "dominant_by_n": {str(r["n"]): r["dominant"]["wall_clock"]
+                          for r in runs},
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--sizes", type=int, nargs="+",
+                        default=[1_000, 10_000, 100_000],
+                        help="cluster sizes to sweep (default 1k 10k 100k)")
+    parser.add_argument("--repeats", type=int, default=5,
+                        help="timed dispatches per kernel (default 5)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--warmup-ticks", type=int, default=8,
+                        help="simulated ticks before snapshotting the "
+                             "profiled state (default 8)")
+    parser.add_argument("--no-fallback", action="store_true",
+                        help="skip the classic-Paxos phase kernels")
+    parser.add_argument("--out", type=str, default=None,
+                        help="write the report JSON to FILE "
+                             "(default: stdout)")
+    args = parser.parse_args(argv)
+
+    from rapid_tpu.settings import Settings
+
+    report = dominance_report(args.sizes, Settings(), repeats=args.repeats,
+                              seed=args.seed,
+                              warmup_ticks=args.warmup_ticks,
+                              include_fallback=not args.no_fallback)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(json.dumps(report, indent=2) + "\n")
+    else:
+        sys.stdout.write(json.dumps(report) + "\n")
+        sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

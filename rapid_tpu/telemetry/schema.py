@@ -20,6 +20,13 @@ from typing import Dict, List
 _NUM = (int, float)
 _OPT_INT = (int, type(None))
 
+#: Version of the telemetry payload contract. Bumped whenever a required
+#: field is added/renamed/retyped in any payload spec below; every
+#: top-level BENCH artifact carries it as ``schema_version`` and
+#: validation rejects a mismatch (a stale baseline or a stale validator
+#: should fail loudly, not drift).
+SCHEMA_VERSION = 1
+
 #: RunSummary.as_dict() — the per-run "telemetry" block.
 TELEMETRY_SPEC = {
     "source": (str,),
@@ -36,6 +43,7 @@ TELEMETRY_SPEC = {
     "total_timeouts": (int,),
     "total_probes_sent": (int,),
     "total_probes_failed": (int,),
+    "invariant_violations": (int,),
     "fallback_phase_sent": (dict,),
 }
 
@@ -60,6 +68,40 @@ RUN_SPEC = {
     "ticks_per_sec": _NUM,
     "rounds_per_sec": _NUM,
     "telemetry": (dict,),
+}
+
+#: One per-kernel cost record of the profile observatory
+#: (``rapid_tpu.telemetry.profile.KernelCost.as_dict``).
+KERNEL_COST_SPEC = {
+    "kernel": (str,),
+    "flops": _NUM,
+    "bytes_accessed": _NUM,
+    "argument_bytes": (int,),
+    "output_bytes": (int,),
+    "temp_bytes": (int,),
+    "peak_bytes": (int,),
+    "compile_s": _NUM,
+    "wall_median_s": _NUM,
+    "wall_best_s": _NUM,
+    "repeats": (int,),
+}
+
+#: One per-N entry of the dominance report.
+PROFILE_RUN_SPEC = {
+    "n": (int,),
+    "capacity": (int,),
+    "kernels": (list,),
+    "dominant": (dict,),
+}
+
+#: Top level of the ``--profile-sweep`` dominance report.
+PROFILE_SWEEP_SPEC = {
+    "bench": (str,),
+    "platform": (str,),
+    "k": (int,),
+    "sizes": (list,),
+    "runs": (list,),
+    "dominant_by_n": (dict,),
 }
 
 
@@ -102,12 +144,59 @@ def validate_run_payload(payload, where: str = "payload") -> List[str]:
     return errors
 
 
+def validate_profile_payload(payload, where: str = "payload") -> List[str]:
+    """Validate a ``kernel_profile_sweep`` dominance report."""
+    errors = _check(payload, PROFILE_SWEEP_SPEC, where)
+    if not isinstance(payload, dict):
+        return errors
+    for i, run in enumerate(payload.get("runs") or []):
+        rw = f"{where}.runs[{i}]"
+        errors += _check(run, PROFILE_RUN_SPEC, rw)
+        if not isinstance(run, dict):
+            continue
+        names = set()
+        for j, kc in enumerate(run.get("kernels") or []):
+            errors += _check(kc, KERNEL_COST_SPEC, f"{rw}.kernels[{j}]")
+            if isinstance(kc, dict) and isinstance(kc.get("kernel"), str):
+                names.add(kc["kernel"])
+        dom = run.get("dominant")
+        if isinstance(dom, dict):
+            for axis, kernel in dom.items():
+                if kernel not in names:
+                    errors.append(f"{rw}.dominant.{axis}: {kernel!r} "
+                                  f"names no profiled kernel")
+    dom_by_n = payload.get("dominant_by_n")
+    if isinstance(dom_by_n, dict):
+        for n, kernel in dom_by_n.items():
+            if not isinstance(kernel, str):
+                errors.append(f"{where}.dominant_by_n[{n}]: expected str, "
+                              f"got {type(kernel).__name__}")
+    return errors
+
+
+def _version_errors(payload) -> List[str]:
+    v = payload.get("schema_version")
+    if v is None:
+        return ["payload.schema_version: missing"]
+    if not isinstance(v, int) or isinstance(v, bool):
+        return [f"payload.schema_version: expected int, "
+                f"got {type(v).__name__}"]
+    if v != SCHEMA_VERSION:
+        return [f"payload.schema_version: expected {SCHEMA_VERSION}, "
+                f"got {v}"]
+    return []
+
+
 def validate_bench_payload(payload) -> List[str]:
-    """Validate a single-run, sweep, or suite (root ``bench.py``) payload."""
+    """Validate a single-run, sweep, suite (root ``bench.py``), or
+    kernel-profile payload. Top-level payloads must carry a matching
+    ``schema_version``."""
     if not isinstance(payload, dict):
         return ["payload: expected a JSON object"]
+    errors = _version_errors(payload)
+    if payload.get("bench") == "kernel_profile_sweep":
+        return errors + validate_profile_payload(payload)
     if payload.get("bench") == "engine_tick_suite":
-        errors = []
         for key in ("steady", "churn", "contested"):
             if key not in payload:
                 errors.append(f"payload.{key}: missing")
@@ -116,11 +205,10 @@ def validate_bench_payload(payload) -> List[str]:
                                                f"payload.{key}")
         return errors
     if "sweep" in payload:
-        errors = []
         for i, run in enumerate(payload["sweep"]):
             errors += validate_run_payload(run, f"payload.sweep[{i}]")
         return errors
-    return validate_run_payload(payload)
+    return errors + validate_run_payload(payload)
 
 
 def main(argv=None) -> int:

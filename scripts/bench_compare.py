@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Bench-regression gate: diff a bench payload against the committed
+baseline.
+
+Protocol counts in the engine are fully deterministic — the synthetic
+identities, the crash burst, the churn plan, and the contested-consensus
+schedule are all seeded — so announcements, decisions, per-view-change
+message traffic, per-phase fallback counts, and invariant-violation
+counts must match the committed ``benchmarks/baseline.json`` *exactly*;
+any drift is a protocol change that either updates the baseline
+deliberately or is a bug. Wall-clock throughput is machine-dependent, so
+``ticks_per_sec`` regressions only warn (default tolerance 30%).
+
+Usage (wired into ``scripts/tier1.sh``)::
+
+    python bench.py --n 256 --ticks 120 --out /tmp/bench.json
+    python scripts/bench_compare.py /tmp/bench.json
+
+Exit codes: 0 = clean (warnings allowed), 1 = protocol drift, schema
+violation, or config mismatch, 2 = usage. ``--update-baseline`` rewrites
+the baseline from the current payload (after schema validation) for
+deliberate protocol changes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from rapid_tpu.telemetry.schema import validate_bench_payload  # noqa: E402
+
+#: Run-config keys that must match for the count comparison to mean
+#: anything; a mismatch is an error telling the caller to regenerate.
+CONFIG_KEYS = ("n", "ticks", "k")
+
+#: Deterministic protocol counts at the run level (compared when present
+#: on either side — scenarios carry different subsets).
+PROTOCOL_RUN_KEYS = (
+    "announcements", "decisions", "final_members", "crashed_nodes",
+    "churn_bursts", "burst_size", "contested_instances",
+    "ticks_to_first_decide", "messages_per_view_change",
+)
+
+#: Deterministic protocol counts inside the telemetry block, including
+#: the full per-view-change rows and the per-phase fallback traffic.
+PROTOCOL_TELEMETRY_KEYS = (
+    "announcements", "decisions", "ticks_to_first_announce",
+    "ticks_to_first_decide", "messages_per_view_change", "total_sent",
+    "total_delivered", "total_dropped", "total_timeouts",
+    "total_probes_sent", "total_probes_failed", "invariant_violations",
+    "fallback_phase_sent", "view_changes",
+)
+
+
+def compare_run(current: Dict, baseline: Dict, where: str,
+                tps_tolerance: float) -> Tuple[List[str], List[str]]:
+    """Diff one run payload; returns (errors, warnings)."""
+    errors: List[str] = []
+    warnings: List[str] = []
+
+    for key in CONFIG_KEYS:
+        if current.get(key) != baseline.get(key):
+            errors.append(
+                f"{where}.{key}: config mismatch (current "
+                f"{current.get(key)!r} vs baseline {baseline.get(key)!r}) "
+                f"— regenerate the baseline with --update-baseline")
+            return errors, warnings  # counts are meaningless across configs
+
+    for key in PROTOCOL_RUN_KEYS:
+        if key not in current and key not in baseline:
+            continue
+        if current.get(key) != baseline.get(key):
+            errors.append(f"{where}.{key}: {current.get(key)!r} != "
+                          f"baseline {baseline.get(key)!r}")
+
+    cur_tel = current.get("telemetry") or {}
+    base_tel = baseline.get("telemetry") or {}
+    for key in PROTOCOL_TELEMETRY_KEYS:
+        if cur_tel.get(key) != base_tel.get(key):
+            errors.append(f"{where}.telemetry.{key}: {cur_tel.get(key)!r} "
+                          f"!= baseline {base_tel.get(key)!r}")
+
+    cur_tps = current.get("ticks_per_sec")
+    base_tps = baseline.get("ticks_per_sec")
+    if isinstance(cur_tps, (int, float)) and \
+            isinstance(base_tps, (int, float)) and base_tps > 0:
+        if cur_tps < base_tps * (1.0 - tps_tolerance):
+            drop = 100.0 * (1.0 - cur_tps / base_tps)
+            warnings.append(
+                f"{where}.ticks_per_sec: {cur_tps} is {drop:.0f}% below "
+                f"baseline {base_tps} (tolerance "
+                f"{tps_tolerance * 100:.0f}%)")
+    return errors, warnings
+
+
+def compare_payloads(current: Dict, baseline: Dict,
+                     tps_tolerance: float) -> Tuple[List[str], List[str]]:
+    """Diff two schema-valid payloads (suite or single run)."""
+    cur_kind = current.get("bench")
+    base_kind = baseline.get("bench")
+    if cur_kind != base_kind:
+        return ([f"payload.bench: kind mismatch (current {cur_kind!r} vs "
+                 f"baseline {base_kind!r})"], [])
+    if cur_kind == "engine_tick_suite":
+        errors: List[str] = []
+        warnings: List[str] = []
+        for key in ("steady", "churn", "contested"):
+            e, w = compare_run(current.get(key) or {},
+                               baseline.get(key) or {},
+                               f"payload.{key}", tps_tolerance)
+            errors += e
+            warnings += w
+        return errors, warnings
+    return compare_run(current, baseline, "payload", tps_tolerance)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("current", help="bench payload JSON to check")
+    parser.add_argument("--baseline",
+                        default=os.path.join(_REPO, "benchmarks",
+                                             "baseline.json"),
+                        help="committed baseline payload "
+                             "(default benchmarks/baseline.json)")
+    parser.add_argument("--tps-tolerance", type=float, default=0.30,
+                        help="warn when ticks_per_sec drops more than "
+                             "this fraction below baseline (default 0.30)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="overwrite the baseline with the current "
+                             "payload (schema-validated) and exit 0")
+    args = parser.parse_args(argv)
+
+    with open(args.current) as fh:
+        current = json.load(fh)
+    schema_errors = validate_bench_payload(current)
+    if schema_errors:
+        for e in schema_errors:
+            print(f"bench_compare: current payload schema violation: {e}",
+                  file=sys.stderr)
+        return 1
+
+    if args.update_baseline:
+        with open(args.baseline, "w") as fh:
+            fh.write(json.dumps(current, indent=2) + "\n")
+        print(f"bench_compare: baseline updated: {args.baseline}")
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(f"bench_compare: no baseline at {args.baseline}; create one "
+              f"with --update-baseline", file=sys.stderr)
+        return 1
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+    schema_errors = validate_bench_payload(baseline)
+    if schema_errors:
+        for e in schema_errors:
+            print(f"bench_compare: baseline schema violation: {e}",
+                  file=sys.stderr)
+        return 1
+
+    errors, warnings = compare_payloads(current, baseline,
+                                        args.tps_tolerance)
+    for w in warnings:
+        print(f"bench_compare: WARNING: {w}", file=sys.stderr)
+    if errors:
+        for e in errors:
+            print(f"bench_compare: protocol drift: {e}", file=sys.stderr)
+        print(f"bench_compare: FAILED ({len(errors)} drift(s) vs "
+              f"{args.baseline})", file=sys.stderr)
+        return 1
+    print(f"bench_compare: ok ({args.current} matches {args.baseline}"
+          f"{', ' + str(len(warnings)) + ' warning(s)' if warnings else ''})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

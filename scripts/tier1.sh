@@ -17,13 +17,18 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
-# Telemetry smoke: the root bench shim must emit a schema-valid payload
-# (CPU-only, small N so it stays cheap). Only meaningful when the test
-# suite itself passed.
+# Telemetry smoke + regression gate: the root bench shim must emit a
+# schema-valid payload whose deterministic protocol counts match the
+# committed benchmarks/baseline.json exactly (bench_compare.py hard-fails
+# on drift, warns on >30% ticks/s regression). Same config as the
+# baseline: N=256, 120 ticks, so the steady crash burst actually decides
+# (~tick 113) and the counts are non-trivial. Only meaningful when the
+# test suite itself passed.
 if [ "$rc" -eq 0 ]; then
     if timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py \
-            --n 256 --ticks 8 --out /tmp/_t1_bench.json >/dev/null \
-        && python -m rapid_tpu.telemetry.schema /tmp/_t1_bench.json; then
+            --n 256 --ticks 120 --out /tmp/_t1_bench.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_bench.json \
+        && python scripts/bench_compare.py /tmp/_t1_bench.json; then
         echo BENCH_SMOKE=ok
     else
         echo BENCH_SMOKE=failed
@@ -42,6 +47,22 @@ if [ "$rc" -eq 0 ]; then
         echo CONTESTED_SMOKE=ok
     else
         echo CONTESTED_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Kernel-profile smoke: the per-kernel cost observatory must lower every
+# sub-kernel and emit a schema-valid dominance report (small N, few
+# repeats — the full 1k/10k/100k sweep is run manually; see
+# benchmarks/dominance_report.json).
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/bench_engine.py \
+            --profile-sweep --profile-sizes 256 --profile-repeats 2 \
+            --out /tmp/_t1_profile.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_profile.json; then
+        echo PROFILE_SMOKE=ok
+    else
+        echo PROFILE_SMOKE=failed
         rc=1
     fi
 fi

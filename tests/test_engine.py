@@ -189,6 +189,30 @@ def test_engine_step_smoke_n64_single_trace():
     assert state_config_id(state3) == view.get_current_configuration_id()
 
 
+def test_simulate_scan_compiles_step_body_exactly_once():
+    from dataclasses import replace
+
+    # Compile stability for the scanned path: lax.scan must trace the
+    # tick body once for the whole run, and an identical second run must
+    # hit the jit cache without retracing (fresh Settings row as above).
+    settings = replace(SETTINGS, seed=4321)
+    endpoints, _, view = make_members(32)
+    uids = [uid_of(e) for e in endpoints]
+    state = init_state(uids, view._id_fp_sum, settings)
+    crash = [I32_MAX] * 32
+    crash[3] = 5
+    faults = crash_faults(crash)
+
+    reset_trace_count()
+    final, logs = simulate(state, faults, 40, settings)
+    assert trace_count() == 1, \
+        "a 40-tick scan must trace the step body exactly once"
+    assert int(final.tick) == 40
+
+    simulate(state, faults, 40, settings)
+    assert trace_count() == 1, "identical rerun must not retrace"
+
+
 def test_simulate_scan_matches_stepwise():
     _, _, state = boot_engine(16)
     crash = [I32_MAX] * 16

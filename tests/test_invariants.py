@@ -1,0 +1,324 @@
+"""On-device invariant monitor: clean runs stay silent, seeded
+corruptions flag the exact bit at the exact tick, escalation names both,
+and the disabled path compiles the checks out entirely."""
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rapid_tpu import hashing
+from rapid_tpu.engine import invariants
+from rapid_tpu.engine.invariants import (ALL_BITS, BIT_OF,
+                                         InvariantViolationError, check_run,
+                                         check_step, describe_bits,
+                                         expand_violations)
+from rapid_tpu.engine.paxos import synthetic_contested_schedule
+from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+from rapid_tpu.engine.step import simulate
+from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry.metrics import engine_metrics, summarize
+
+# Distinct seeds keep each test's Settings a fresh jit-cache row, so no
+# test inherits another's compiled step.
+SETTINGS = Settings(invariant_checks=True, seed=7001)
+
+
+def synthetic_uids(n: int, seed: int = 0) -> np.ndarray:
+    """Same synthetic identity scheme as benchmarks/bench_engine.py."""
+    hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF ^ (seed & 0xFFFF))
+    return hashing.np_from_limbs(hi, lo)
+
+
+def boot(n: int, settings=SETTINGS, member=None):
+    return init_state(synthetic_uids(n), id_fp_sum=0, settings=settings,
+                      member=member)
+
+
+def no_faults(n: int):
+    return crash_faults([I32_MAX] * n)
+
+
+def bit(name: str) -> int:
+    return 1 << BIT_OF[name]
+
+
+# ---------------------------------------------------------------------------
+# registry / decoding
+# ---------------------------------------------------------------------------
+
+
+def test_bit_registry_is_append_only_contract():
+    # Bit positions are part of the telemetry contract; renumbering would
+    # silently re-label persisted BENCH artifacts.
+    assert [b for _, b in invariants.INVARIANT_BITS] == [0, 1, 2, 3, 4, 5]
+    assert BIT_OF["ring_degree"] == 0
+    assert BIT_OF["memsum"] == 5
+    assert ALL_BITS == 0b111111
+
+
+def test_describe_bits_decodes_in_bit_order():
+    assert describe_bits(0) == []
+    assert describe_bits(bit("memsum") | bit("ring_degree")) == \
+        ["ring_degree", "memsum"]
+    assert describe_bits(ALL_BITS) == [n for n, _ in
+                                       invariants.INVARIANT_BITS]
+
+
+# ---------------------------------------------------------------------------
+# clean runs: monitor on, zero violations
+# ---------------------------------------------------------------------------
+
+
+def test_clean_steady_run_n256_zero_violations():
+    n = 256
+    crash = [I32_MAX] * n
+    for slot in range(0, n, 64):
+        crash[slot] = 5
+    state = boot(n)
+    final, logs = simulate(state, crash_faults(crash), 130, SETTINGS)
+    assert int(np.asarray(logs.inv_bits).max()) == 0
+    assert expand_violations(logs) == []
+    check_run(logs)  # no-op on a clean run
+    metrics = engine_metrics(logs)
+    summary = summarize(metrics)
+    assert summary.invariant_violations == 0
+    assert summary.decisions >= 1  # the crash burst actually decided
+
+
+def test_clean_contested_run_exercises_rank_invariants():
+    # Classic-Paxos fallback rounds mutate every px_* rank array; the
+    # rank_order / unique_decide checks must stay silent through them.
+    n = 64
+    settings = replace(SETTINGS, seed=7002)
+    uids = synthetic_uids(n)
+    sched, info = synthetic_contested_schedule(n, settings, 48, uids=uids)
+    state = init_state(uids, id_fp_sum=0, settings=settings)
+    _, logs = simulate(state, no_faults(n), 48, settings,
+                       fallback=sched)
+    assert info["instances"] >= 1
+    assert int(np.asarray(logs.inv_bits).max()) == 0
+
+
+# ---------------------------------------------------------------------------
+# injected corruptions: exact bit, exact tick
+# ---------------------------------------------------------------------------
+
+
+def test_memsum_corruption_flags_bit5_from_first_tick():
+    n = 64
+    settings = replace(SETTINGS, seed=7003)
+    state = boot(n, settings)
+    state = state._replace(memsum_lo=state.memsum_lo + jnp.uint32(1))
+    _, logs = simulate(state, no_faults(n), 4, settings)
+    rows = expand_violations(logs)
+    assert rows[0] == (1, bit("memsum"), ["memsum"])
+    assert len(rows) == 4  # the corrupted sum persists every tick
+
+
+def test_broken_ring_edge_flags_ring_degree():
+    # A member row whose observer edge self-points is not a single K-ring
+    # cycle any more; the monitor must flag it even though no alert fires.
+    n = 64
+    settings = replace(SETTINGS, seed=7004)
+    state = boot(n, settings)
+    state = state._replace(obs_idx=state.obs_idx.at[5, 0].set(5))
+    _, logs = simulate(state, no_faults(n), 3, settings)
+    rows = expand_violations(logs)
+    assert rows[0] == (1, bit("ring_degree"), ["ring_degree"])
+
+
+def test_dormant_row_corruption_flags_ring_degree():
+    # Dormant rows must self-point both directions; pointing one at a
+    # member slot means the topology rebuild was corrupted.
+    n = 64
+    member = np.ones(n, bool)
+    member[-8:] = False
+    settings = replace(SETTINGS, seed=7005)
+    state = boot(n, settings, member=member)
+    state = state._replace(subj_idx=state.subj_idx.at[n - 1, 0].set(0))
+    _, logs = simulate(state, no_faults(n), 3, settings)
+    rows = expand_violations(logs)
+    assert rows[0] == (1, bit("ring_degree"), ["ring_degree"])
+
+
+def test_rank_corruption_flags_rank_order():
+    # vrnd > rnd violates the classic-Paxos promise ordering (and a
+    # non-zero vrnd without a value is doubly malformed — same bit).
+    n = 64
+    settings = replace(SETTINGS, seed=7006)
+    state = boot(n, settings)
+    state = state._replace(px_vrnd_r=state.px_vrnd_r.at[3].set(5))
+    _, logs = simulate(state, no_faults(n), 3, settings)
+    rows = expand_violations(logs)
+    assert rows[0] == (1, bit("rank_order"), ["rank_order"])
+
+
+def test_empty_proposal_decide_flags_unique_decide():
+    # Forge a fast round about to reach quorum for an *empty* proposal
+    # mask: every member voted, fingerprints agree, but the decision
+    # carries no change — a protocol impossibility the monitor must flag
+    # the tick the votes land.
+    n = 64
+    settings = replace(SETTINGS, seed=7007)
+    state = boot(n, settings)
+    state = state._replace(
+        announced=jnp.asarray(True),
+        vote_pending=jnp.asarray(True),
+        voters=state.member,
+        announce_tick=state.tick,  # votes land next tick
+    )
+    _, logs = simulate(state, no_faults(n), 2, settings)
+    rows = expand_violations(logs)
+    assert rows, "forged empty-proposal quorum was not flagged"
+    tick, bits, names = rows[0]
+    assert tick == 1
+    assert bits & bit("unique_decide")
+    assert "unique_decide" in names
+
+
+# ---------------------------------------------------------------------------
+# check_step unit semantics (direct call, no scan)
+# ---------------------------------------------------------------------------
+
+
+def _step_bits(pre, post, decide=False, fast=False, classic=False,
+               classic_mask=None):
+    n = pre.member.shape[0]
+    return int(check_step(
+        jnp, pre, post,
+        decide_now=jnp.asarray(decide),
+        fast_decide=jnp.asarray(fast),
+        classic_decide=jnp.asarray(classic),
+        fast_mask=pre.proposal,
+        classic_mask=(jnp.zeros(n, bool) if classic_mask is None
+                      else classic_mask)))
+
+
+def test_check_step_epoch_regression_flags_epoch_monotone():
+    pre = boot(8, replace(SETTINGS, seed=7008))
+    post = pre._replace(epoch=pre.epoch - jnp.int32(1))
+    bits = _step_bits(pre, post)
+    assert bits & bit("epoch_monotone")
+    # decide_now=True must demand epoch advance by exactly one
+    assert _step_bits(pre, pre, decide=True) & bit("epoch_monotone")
+    assert not _step_bits(pre, pre._replace(epoch=pre.epoch + 1),
+                          decide=True) & bit("epoch_monotone")
+
+
+def test_check_step_report_retraction_flags_report_monotone():
+    base = boot(8, replace(SETTINGS, seed=7009))
+    pre = base._replace(reports=base.reports.at[0, 0].set(True))
+    post = pre._replace(reports=jnp.zeros_like(pre.reports))
+    assert _step_bits(pre, post) & bit("report_monotone")
+    # ...but a decided view change legitimately clears the detector
+    assert not _step_bits(pre, post._replace(epoch=pre.epoch + 1),
+                          decide=True, fast=True) & bit("report_monotone")
+
+
+def test_check_step_double_decide_flags_unique_decide():
+    pre = boot(8, replace(SETTINGS, seed=7010))
+    pre = pre._replace(announced=jnp.asarray(True),
+                       proposal=pre.proposal.at[0].set(True))
+    post = pre._replace(epoch=pre.epoch + 1)
+    both = _step_bits(pre, post, decide=True, fast=True, classic=True,
+                      classic_mask=pre.proposal)
+    assert both & bit("unique_decide")
+    # an un-announced fast decision is equally impossible
+    ghost = pre._replace(announced=jnp.asarray(False))
+    assert _step_bits(ghost, ghost._replace(epoch=ghost.epoch + 1),
+                      decide=True, fast=True) & bit("unique_decide")
+    # a legitimate single-source decision passes
+    assert not _step_bits(pre, post, decide=True, fast=True) \
+        & bit("unique_decide")
+
+
+# ---------------------------------------------------------------------------
+# escalation
+# ---------------------------------------------------------------------------
+
+
+def test_check_run_raises_naming_tick_and_invariants(tmp_path):
+    n = 64
+    settings = replace(SETTINGS, seed=7011)
+    state = boot(n, settings)
+    state = state._replace(memsum_lo=state.memsum_lo + jnp.uint32(1))
+    final, logs = simulate(state, no_faults(n), 4, settings)
+    metrics = engine_metrics(logs)
+    artifact = str(tmp_path / "inv.jsonl")
+    with pytest.raises(InvariantViolationError) as exc:
+        check_run(logs, metrics=metrics, artifact=artifact)
+    err = exc.value
+    assert err.report.tick == 1
+    assert err.report.field == "invariants.memsum"
+    assert err.report.engine == bit("memsum")
+    assert "tick 1" in str(err) and "memsum" in str(err)
+    # the JSONL artifact landed and carries the violation records
+    lines = (tmp_path / "inv.jsonl").read_text().strip().splitlines()
+    assert lines
+    assert any("invariant_violation" in ln for ln in lines)
+
+
+def test_telemetry_gauge_counts_violating_ticks():
+    n = 64
+    settings = replace(SETTINGS, seed=7012)
+    state = boot(n, settings)
+    state = state._replace(px_vrnd_r=state.px_vrnd_r.at[0].set(9))
+    final, logs = simulate(state, no_faults(n), 5, settings)
+    metrics = engine_metrics(logs)
+    assert all(m.invariant_violations == bit("rank_order")
+               for m in metrics)
+    assert summarize(metrics).invariant_violations == 5
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when disabled
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_monitor_never_calls_check_step(monkeypatch):
+    import importlib
+
+    step_module = importlib.import_module("rapid_tpu.engine.step")
+    calls = []
+    real = invariants.check_step
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    # step.py calls invariants.check_step by module attribute, so the spy
+    # sees every compile-time entry into the monitor.
+    monkeypatch.setattr(invariants, "check_step", spy)
+
+    n = 16
+    off = Settings(invariant_checks=False, seed=7013)
+    on = replace(off, invariant_checks=True)
+    state = boot(n, off)
+    faults = no_faults(n)
+
+    step_module.step(state, faults, off)
+    assert calls == [], "disabled monitor must never enter invariants.py"
+    step_module.step(state, faults, on)
+    assert len(calls) == 1
+
+    # The flag is static: the enabled jaxpr strictly grows, the disabled
+    # one carries only the constant-zero inv_bits leaf.
+    off_eqns = len(jax.make_jaxpr(
+        lambda s, f: step_module.step(s, f, off))(state, faults).eqns)
+    on_eqns = len(jax.make_jaxpr(
+        lambda s, f: step_module.step(s, f, on))(state, faults).eqns)
+    assert on_eqns > off_eqns
+
+
+def test_disabled_monitor_logs_constant_zero_bits():
+    n = 32
+    settings = Settings(invariant_checks=False, seed=7014)
+    state = boot(n, settings)
+    # Even a corrupted state logs 0 with the monitor off: the checks are
+    # compiled out, not merely ignored.
+    state = state._replace(memsum_lo=state.memsum_lo + jnp.uint32(1))
+    _, logs = simulate(state, no_faults(n), 3, settings)
+    assert int(np.asarray(logs.inv_bits).max()) == 0

@@ -1,0 +1,115 @@
+"""Per-kernel cost observatory: every sub-kernel lowers and reports
+non-trivial XLA costs, the dominance report validates against the
+telemetry schema, and the --profile-sweep CLI path round-trips."""
+import json
+
+import pytest
+
+from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry import profile as tprofile
+from rapid_tpu.telemetry import schema as tschema
+
+SETTINGS = Settings()
+
+ALL_KERNELS = set(tprofile.KERNEL_ORDER)
+SUB_KERNELS = ALL_KERNELS - {"full_step"}
+
+
+def test_kernel_cases_cover_the_step_with_and_without_fallback():
+    from rapid_tpu.engine.paxos import empty_fallback_schedule
+
+    state, faults = tprofile.synthetic_state(64, SETTINGS, warmup_ticks=2)
+    lean = [name for name, _, _ in
+            tprofile.kernel_cases(state, faults, SETTINGS, fallback=None)]
+    assert lean == ["topology_rebuild", "monitor", "cut_aggregate",
+                    "vote_count", "full_step"]
+    c = int(state.member.shape[0])
+    full = [name for name, _, _ in
+            tprofile.kernel_cases(state, faults, SETTINGS,
+                                  fallback=empty_fallback_schedule(c))]
+    assert full == list(tprofile.KERNEL_ORDER)
+
+
+def test_measure_kernel_reports_static_and_measured_costs():
+    state, faults = tprofile.synthetic_state(64, SETTINGS, warmup_ticks=2)
+    name, fn, args = tprofile.kernel_cases(state, faults, SETTINGS)[0]
+    cost = tprofile.measure_kernel(name, fn, args, repeats=2)
+    assert cost.kernel == "topology_rebuild"
+    assert cost.flops > 0
+    assert cost.bytes_accessed > 0
+    assert cost.argument_bytes > 0
+    assert cost.peak_bytes >= cost.argument_bytes
+    assert cost.compile_s > 0
+    assert 0 < cost.wall_best_s <= cost.wall_median_s
+    assert cost.repeats == 2
+
+
+def test_dominance_report_schema_and_dominants():
+    report = tprofile.dominance_report([64], SETTINGS, repeats=1,
+                                       warmup_ticks=2)
+    assert tschema.validate_bench_payload(report) == []
+    assert report["bench"] == "kernel_profile_sweep"
+    assert report["schema_version"] == tschema.SCHEMA_VERSION
+    assert report["sizes"] == [64]
+    (run,) = report["runs"]
+    assert run["n"] == 64
+    assert {k["kernel"] for k in run["kernels"]} == ALL_KERNELS
+    # full_step is the composed reference and never dominant
+    for axis in ("wall_clock", "flops", "bytes"):
+        assert run["dominant"][axis] in SUB_KERNELS
+    assert report["dominant_by_n"] == {"64": run["dominant"]["wall_clock"]}
+    assert run["subkernel_wall_fraction"] is None \
+        or run["subkernel_wall_fraction"] > 0
+
+
+def test_schema_rejects_corrupt_dominance_report():
+    report = tprofile.dominance_report([32], SETTINGS, repeats=1,
+                                       warmup_ticks=0,
+                                       include_fallback=False)
+    assert tschema.validate_bench_payload(report) == []
+    # dominant kernel must name a profiled kernel
+    bad = json.loads(json.dumps(report))
+    bad["runs"][0]["dominant"]["wall_clock"] = "warp_drive"
+    assert tschema.validate_bench_payload(bad)
+    # schema_version is mandatory and pinned
+    bad = json.loads(json.dumps(report))
+    bad["schema_version"] = tschema.SCHEMA_VERSION + 1
+    assert tschema.validate_bench_payload(bad)
+    bad = json.loads(json.dumps(report))
+    del bad["schema_version"]
+    assert tschema.validate_bench_payload(bad)
+    # a kernel row missing a cost field is rejected
+    bad = json.loads(json.dumps(report))
+    del bad["runs"][0]["kernels"][0]["flops"]
+    assert tschema.validate_bench_payload(bad)
+
+
+def test_profile_sweep_cli_writes_schema_valid_report(tmp_path):
+    from benchmarks.bench_engine import main as bench_main
+
+    out = tmp_path / "profile.json"
+    rc = bench_main(["--profile-sweep", "--profile-sizes", "64",
+                     "--profile-repeats", "1", "--out", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    assert tschema.validate_bench_payload(payload) == []
+    assert payload["bench"] == "kernel_profile_sweep"
+    assert list(payload["dominant_by_n"]) == ["64"]
+
+
+def test_committed_dominance_artifact_is_schema_valid():
+    # benchmarks/dominance_report.json is the ROADMAP pjit-gate artifact;
+    # it must stay schema-valid and name a dominant kernel at every N.
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "dominance_report.json")
+    if not os.path.exists(path):
+        pytest.skip("dominance_report.json not generated")
+    with open(path) as fh:
+        payload = json.load(fh)
+    assert tschema.validate_bench_payload(payload) == []
+    assert set(payload["dominant_by_n"]) == \
+        {str(n) for n in payload["sizes"]}
+    assert all(dom in SUB_KERNELS
+               for dom in payload["dominant_by_n"].values())

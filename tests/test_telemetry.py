@@ -279,6 +279,7 @@ def test_bench_run_payload_passes_schema():
 def test_schema_rejects_malformed_payload():
     good = {
         "bench": "engine_tick", "n": 64, "ticks": 20, "wall_s": 0.1,
+        "schema_version": tschema.SCHEMA_VERSION,
         "ticks_per_sec": 200.0, "rounds_per_sec": 40.0,
         "telemetry": summarize([]).as_dict(),
     }
@@ -291,6 +292,26 @@ def test_schema_rejects_malformed_payload():
     bad["telemetry"] = dict(good["telemetry"], decisions="three")
     assert any("decisions" in e for e in
                tschema.validate_bench_payload(bad))
-    suite = {"bench": "engine_tick_suite", "steady": good}
+    suite = {"bench": "engine_tick_suite",
+             "schema_version": tschema.SCHEMA_VERSION, "steady": good}
     assert any("churn" in e for e in
                tschema.validate_bench_payload(suite))
+
+
+def test_schema_version_is_mandatory_and_pinned():
+    good = {
+        "bench": "engine_tick", "n": 64, "ticks": 20, "wall_s": 0.1,
+        "schema_version": tschema.SCHEMA_VERSION,
+        "ticks_per_sec": 200.0, "rounds_per_sec": 40.0,
+        "telemetry": summarize([]).as_dict(),
+    }
+    assert tschema.validate_bench_payload(good) == []
+    missing = {k: v for k, v in good.items() if k != "schema_version"}
+    assert any("schema_version" in e for e in
+               tschema.validate_bench_payload(missing))
+    stale = dict(good, schema_version=tschema.SCHEMA_VERSION + 1)
+    assert any("schema_version" in e for e in
+               tschema.validate_bench_payload(stale))
+    mistyped = dict(good, schema_version="1")
+    assert any("schema_version" in e for e in
+               tschema.validate_bench_payload(mistyped))

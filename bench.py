@@ -1,11 +1,15 @@
 #!/usr/bin/env python
-"""Repo-root benchmark shim: steady + churn + contested suite, JSON out.
+"""Repo-root benchmark shim: steady + churn + contested + partition
+suite, JSON out.
 
 This is the harness entry point (``python bench.py``): it runs the
-engine tick benchmark three times — an N=1k steady crash-burst, an N=1k
-sustained-churn run, and an N=1k contested-consensus run through the
-classic-Paxos fallback kernel — with defaults small enough to finish
-quickly on CPU, and emits a single ``engine_tick_suite`` JSON payload.
+engine tick benchmark four times — an N=1k steady crash-burst, an N=1k
+sustained-churn run, an N=1k contested-consensus run through the
+classic-Paxos fallback kernel, and a small one-way-partition run
+through the fault adversary (a host-side oracle differential, so it
+uses its own ``--partition-n`` size) — with defaults small enough to
+finish quickly on CPU, and emits a single ``engine_tick_suite`` JSON
+payload.
 
 The stdout payload is always one compact *summary-only* line (the last
 line, explicitly flushed, so harnesses that parse the stdout tail always
@@ -35,7 +39,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from benchmarks.bench_engine import run, run_churn, run_contested  # noqa: E402
+from benchmarks.bench_engine import (  # noqa: E402
+    run,
+    run_churn,
+    run_contested,
+    run_partition,
+)
 
 
 def _compact_payload(payload: dict) -> dict:
@@ -48,7 +57,7 @@ def _compact_payload(payload: dict) -> dict:
     artifact keeps the full rows.
     """
     out = dict(payload)
-    for key in ("steady", "churn", "contested"):
+    for key in ("steady", "churn", "contested", "partition"):
         run_p = dict(out[key])
         tel = dict(run_p["telemetry"])
         tel["view_changes_elided"] = len(tel.get("view_changes") or [])
@@ -68,6 +77,14 @@ def main(argv=None) -> int:
                         help="churn run: slots per join/leave burst")
     parser.add_argument("--seed", type=int, default=0,
                         help="perturbs the synthetic node identities")
+    parser.add_argument("--partition-n", type=int, default=64,
+                        help="cluster size for the partition run (a "
+                             "host-side adversary differential, O(n^2) "
+                             "per tick; default 64)")
+    parser.add_argument("--partition-ticks", type=int, default=300,
+                        help="ticks for the partition run (needs to "
+                             "cover FD saturation plus the classic "
+                             "fallback round; default 300)")
     parser.add_argument("--out", type=str, default=None,
                         help="write the JSON artifact to FILE "
                              "(default: stdout)")
@@ -87,6 +104,8 @@ def main(argv=None) -> int:
         "churn": run_churn(args.n, args.ticks, args.burst, settings,
                            args.seed),
         "contested": run_contested(args.n, args.ticks, settings, args.seed),
+        "partition": run_partition(args.partition_n, args.partition_ticks,
+                                   settings, args.seed),
     }
     if args.out:
         with open(args.out, "w") as fh:

@@ -12,6 +12,14 @@ Two scenarios, selected with ``--scenario``:
   ``rapid_tpu.engine.paxos.synthetic_contested_schedule`` — the fast
   round misses quorum every time and the classic-Paxos fallback kernel
   decides each view change.
+- ``partition``: an asymmetric one-way partition through the fault
+  adversary (``rapid_tpu.engine.adversary``) — enough slots isolated
+  that the fast round misses quorum and the organic classic-Paxos
+  fallback decides under the partition. This scenario runs the host
+  discrete-event engine *and* the oracle and asserts bit-identity
+  before reporting, so it is a correctness gate as much as a
+  benchmark; it is O(n^2) per tick on the host, keep ``--n`` small
+  (64-256).
 
 One *gossip round* is one failure-detector interval — the period in
 which every node probes each unique subject once — i.e.
@@ -268,6 +276,64 @@ def run_contested(n: int, ticks: int, settings, seed: int = 0,
     }
 
 
+def run_partition(n: int, ticks: int, settings, seed: int = 0,
+                  iso_frac: float = 0.3) -> dict:
+    """Asymmetric one-way partition through the on-device fault
+    adversary: the last ``iso_frac`` of the slot range is isolated
+    one-way (rest->iso blocked), so the reachable side detects the
+    isolated slots but its fast votes fall short of the fast quorum and
+    the organic jittered classic-Paxos fallback decides the removal
+    under the partition. The run is a full adversarial differential —
+    counts are reported only after the engine is proven bit-identical
+    to the oracle."""
+    from rapid_tpu.engine.diff import run_adversarial_differential
+    from rapid_tpu.faults import AdversarySchedule, LinkWindow
+    from rapid_tpu.telemetry.metrics import summarize
+
+    # iso > (n-1)//4 guarantees the fast quorum n-(n-1)//4 is missed
+    # while the classic majority n//2+1 stays reachable.
+    n_iso = max((n - 1) // 4 + 1, int(round(n * iso_frac)))
+    iso = frozenset(range(n - n_iso, n))
+    rest = frozenset(range(n)) - iso
+    schedule = AdversarySchedule(
+        n=n,
+        windows=(LinkWindow(src_slots=rest, dst_slots=iso, start_tick=3),),
+        seed=seed)
+
+    run_start = time.perf_counter()
+    res = run_adversarial_differential(schedule, ticks, settings)
+    wall_s = time.perf_counter() - run_start
+    res.assert_identical()
+
+    telemetry = summarize(res.engine_metrics).as_dict()
+    survivor = min(rest)
+    removed = {s for ev in res.engine_events_by_slot[survivor]
+               if ev.kind == "view_change" for s in ev.slots}
+    ticks_per_sec = ticks / wall_s
+    return {
+        "bench": "engine_tick",
+        "schema_version": _schema_version(),
+        "scenario": "partition",
+        "platform": "host",
+        "n": n,
+        "k": settings.K,
+        "ticks": ticks,
+        "isolated_slots": n_iso,
+        "window_start_tick": 3,
+        "boot_s": 0.0,
+        "compile_s": 0.0,
+        "wall_s": round(wall_s, 4),
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "rounds_per_sec": round(ticks_per_sec / settings.fd_interval_ticks, 2),
+        "announcements": telemetry["announcements"],
+        "decisions": telemetry["decisions"],
+        "final_members": n - len(removed),
+        "ticks_to_first_decide": telemetry["ticks_to_first_decide"],
+        "messages_per_view_change": telemetry["messages_per_view_change"],
+        "telemetry": telemetry,
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--n", type=int, default=10_000,
@@ -280,11 +346,15 @@ def main(argv=None) -> int:
     parser.add_argument("--crash-tick", type=int, default=5,
                         help="tick of the correlated crash burst")
     parser.add_argument("--scenario",
-                        choices=("steady", "churn", "contested"),
+                        choices=("steady", "churn", "contested",
+                                 "partition"),
                         default="steady",
                         help="steady crash-burst, sustained join/leave "
-                             "churn, or contested consensus through the "
-                             "classic-Paxos fallback (default steady)")
+                             "churn, contested consensus through the "
+                             "classic-Paxos fallback, or a one-way "
+                             "partition through the fault adversary "
+                             "(host-side differential; keep --n small "
+                             "and --ticks >= 250) (default steady)")
     parser.add_argument("--burst", type=int, default=8,
                         help="churn scenario: slots per join/leave burst")
     parser.add_argument("--seed", type=int, default=0,
@@ -345,6 +415,12 @@ def main(argv=None) -> int:
         elif args.scenario == "contested":
             results = [run_contested(n, args.ticks, settings, args.seed,
                                      trace_writer=writer)
+                       for n in sizes]
+        elif args.scenario == "partition":
+            if writer is not None:
+                parser.error("--trace records jitted runs; the partition "
+                             "scenario is a host-side differential")
+            results = [run_partition(n, args.ticks, settings, args.seed)
                        for n in sizes]
         else:
             results = [run(n, args.ticks, args.crash_frac, args.crash_tick,

@@ -75,7 +75,12 @@ from rapid_tpu.types import (AlertMessage, EdgeStatus, Endpoint,
 
 class ChurnEnvelopeError(ValueError):
     """The scenario leaves the envelope where the batched engine is
-    bit-identical to the oracle (see module docstring)."""
+    bit-identical to the oracle (see module docstring). For fault-only
+    scenarios (crashes, partitions, scripted proposes — no joins/leaves)
+    no such envelope exists anymore: route them to
+    ``engine.diff.run_adversarial_differential``, whose per-slot adversary
+    engine executes straddling bursts, partition-driven quorum loss and
+    the classic-Paxos fallback exactly."""
 
 
 class ChurnSchedule(NamedTuple):

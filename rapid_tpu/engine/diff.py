@@ -13,15 +13,21 @@ compares:
   exact sent/delivered/dropped/probe tallies that must equal the oracle
   ``SimNetwork`` counters at every tick.
 
-Scenario envelope: crashes within one burst must share their first failing
-failure-detector tick (the smallest FD-interval multiple at/after the crash
-tick), so the whole burst is removed in a single view change. Crashes that
-straddle an FD-interval boundary split into two view changes, leaving a
-crashed-but-still-member node whose *stale* pre-view-change detector state
-(saturated counters, old broadcast membership) the engine's global
-view-change reset does not model — the counter parity check below catches
-exactly that divergence. Bursts must also be separated by enough ticks for
-the previous removal to complete (~fd_threshold * fd_interval + 3).
+Two execution regimes share this harness. The *fleet* differentials
+(``run_differential``, ``run_churn_differential``,
+``run_fallback_differential``) drive the jitted shared-view engine, whose
+planners still require crashes within one burst to share their first
+failing failure-detector tick and bursts to be separated by a full
+removal (~fd_threshold * fd_interval + 3 ticks) — one global view per
+tick cannot carry nodes whose views disagree. The *adversarial*
+differential (``run_adversarial_differential``) has no such envelope: it
+drives ``engine.adversary.AdversaryEngine``, which keeps per-slot views,
+config epochs, cut-detector tables and fallback timers, and therefore
+executes unscripted seeded schedules — asymmetric one-way partitions,
+flip-flop links, crash bursts straddling FD-interval boundaries, tied or
+mid-fast-count fallback timers, rank races — with nothing pre-rejected,
+asserting per-slot events, per-tick counters, per-phase consensus
+traffic and per-slot final config ids against the oracle.
 
 Bootstrapping N oracle nodes through the join protocol is O(N^3) messages;
 ``boot_static_cluster`` instead wires every ``MembershipService`` directly
@@ -131,19 +137,25 @@ def boot_static_cluster(
     endpoints: Sequence[Endpoint],
     node_ids: Sequence[NodeId],
     fault_model: FaultModel = HEALTHY,
+    rngs: Optional[Sequence] = None,
 ) -> Tuple[SimNetwork, List[Cluster], List[_Recorder]]:
     """Wire one converged oracle node per endpoint, in slot order.
 
     Slot order = service creation order, which fixes the scheduler-handle
     order of the periodic jobs — the property that makes the oracle's
-    intra-tick alert order canonical and engine-reproducible.
+    intra-tick alert order canonical and engine-reproducible. ``rngs``
+    injects one ``random.Random`` per slot for the fallback-jitter draws
+    (the cluster's default rng hashes the listen address object, which is
+    ``PYTHONHASHSEED``-dependent — differentials that exercise organic
+    timers must pin the streams).
     """
     network = SimNetwork(settings, fault_model)
     slot_of = {e: i for i, e in enumerate(endpoints)}
     clusters: List[Cluster] = []
     recorders: List[_Recorder] = []
-    for ep in endpoints:
-        cluster = Cluster(network, ep, settings)
+    for i, ep in enumerate(endpoints):
+        cluster = Cluster(network, ep, settings,
+                          rng=rngs[i] if rngs is not None else None)
         recorder = _Recorder(network, slot_of)
         recorder.subscribe(cluster)
         view = MembershipView(settings.K, list(node_ids), list(endpoints))
@@ -725,4 +737,147 @@ def run_churn_differential(
         oracle_members=oracle_members,
         engine_members=engine_members,
         plan_members=plan.final_members,
+    )
+
+
+# ---------------------------------------------------------------------------
+# adversarial differential: unscripted fault schedules, no planner envelope
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AdversaryDiffResult:
+    """Oracle vs the per-slot adversary engine for one fault schedule.
+
+    Under partitions the nodes legitimately see *different* event
+    streams, so the comparison is per slot: every slot's engine stream
+    (proposals, view changes, config ids) against the same slot's oracle
+    recorder, plus total per-tick message counters, per-phase consensus
+    counters, and every slot's final configuration id (meaningful for
+    kicked and crashed nodes too — their views freeze where the protocol
+    left them).
+    """
+
+    n: int
+    n_ticks: int
+    schedule: object
+    oracle_events_by_slot: List[List[ViewEvent]]
+    engine_events_by_slot: List[List[ViewEvent]]
+    oracle_counters: List[Dict[str, int]]
+    engine_counters: List[Dict[str, int]]
+    oracle_phase_counters: List[Dict[str, int]]
+    engine_phase_counters: List[Dict[str, int]]
+    oracle_config_ids: List[int]
+    engine_config_ids: List[int]
+    engine_metrics: Optional[List] = None
+    oracle_metrics: Optional[List] = None
+
+    def first_divergence(self):
+        """Earliest (tick, field) disagreement across all per-slot event
+        streams, counters, phase counters and final per-slot config ids —
+        None when bit-identical."""
+        from rapid_tpu.telemetry import forensics as fz
+
+        candidates = [
+            fz.counters_divergence(self.engine_counters,
+                                   self.oracle_counters),
+            fz.counters_divergence(self.engine_phase_counters,
+                                   self.oracle_phase_counters),
+        ]
+        for s in range(self.n):
+            candidates.append(fz.events_divergence(
+                self.engine_events_by_slot[s],
+                self.oracle_events_by_slot[s], prefix=f"slot{s}.events"))
+            candidates.append(fz.scalar_divergence(
+                f"slot{s}.config_id", self.engine_config_ids[s],
+                self.oracle_config_ids[s], tick=self.n_ticks))
+        div = fz.earliest(candidates)
+        if div is None:
+            return None
+        events = max(self.oracle_events_by_slot, key=len, default=[])
+        return fz.build_report(div, engine_metrics=self.engine_metrics,
+                               oracle_metrics=self.oracle_metrics,
+                               events=events)
+
+    def assert_identical(self, artifact: Optional[str] = None) -> None:
+        """Raise ``DivergenceError`` at the first divergence; see
+        ``DiffResult.assert_identical`` for the artifact contract."""
+        report = self.first_divergence()
+        if report is not None:
+            _raise_divergence(report, artifact)
+
+
+def run_adversarial_differential(
+    schedule,
+    n_ticks: int,
+    settings: Optional[Settings] = None,
+) -> AdversaryDiffResult:
+    """Replay an unscripted :class:`rapid_tpu.faults.AdversarySchedule`
+    through oracle and the per-slot adversary engine.
+
+    Nothing scenario-shaped is screened: the schedule's crashes may
+    straddle FD-interval boundaries, its link windows may partition the
+    monitoring topology asymmetrically or flip-flop, and its scripted
+    proposes may tie timers, fire mid-fast-count, or race coordinator
+    ranks — ``faults.validate_schedule`` only checks genuine input
+    validity. Both sides draw organic fallback jitter from identical
+    per-slot rng streams seeded by ``schedule.seed``.
+    """
+    from rapid_tpu.engine.adversary import AdversaryEngine, adversary_rngs
+    from rapid_tpu.faults import validate_schedule
+    from rapid_tpu.oracle.membership_view import id_fingerprint
+
+    validate_schedule(schedule)
+    settings = settings or Settings()
+    n = schedule.n
+    endpoints = default_endpoints(n)
+    node_ids = default_node_ids(n)
+
+    # --- oracle side ----------------------------------------------------
+    network, clusters, recorders = boot_static_cluster(
+        settings, endpoints, node_ids, schedule.fault_model(endpoints),
+        rngs=adversary_rngs(schedule.seed, n))
+    view0 = clusters[0].membership_service.view
+    # Scripted proposes register after boot in schedule order — the same
+    # handle order the engine replicates. ``fast_paxos`` resolves at fire
+    # time so a propose after a view change lands on the live instance.
+    for p in schedule.proposes:
+        ordered = sorted((endpoints[s] for s in p.proposal),
+                         key=view0.ring0_sort_key)
+        network.at(p.tick,
+                   lambda svc=clusters[p.slot].membership_service,
+                   prop=ordered, d=p.delay_ticks:
+                   svc.fast_paxos.propose(prop, recovery_delay_ticks=d))
+    oracle_counts = run_oracle(network, n_ticks)
+    oracle_phase = [dict(d) for d in network.consensus_history]
+    oracle_cfgs = [c.membership_service.view.get_current_configuration_id()
+                   for c in clusters]
+
+    # --- engine side ----------------------------------------------------
+    uids = [uid_of(e) for e in endpoints]
+    id_fp_sum = sum(id_fingerprint(nid) for nid in node_ids) & ((1 << 64) - 1)
+    engine = AdversaryEngine(schedule, uids, id_fp_sum, settings)
+    run = engine.run(n_ticks)
+
+    from rapid_tpu.telemetry import metrics as telemetry_metrics
+
+    all_oracle_events = sorted(
+        {e for r in recorders for e in r.events},
+        key=lambda e: (e.tick, e.kind))
+    return AdversaryDiffResult(
+        n=n, n_ticks=n_ticks, schedule=schedule,
+        oracle_events_by_slot=[list(r.events) for r in recorders],
+        engine_events_by_slot=[
+            [ViewEvent(tick=t, kind=k, config_id=c, slots=slots)
+             for t, k, c, slots in evs]
+            for evs in run.events_by_slot],
+        oracle_counters=oracle_counts,
+        engine_counters=run.tick_history,
+        oracle_phase_counters=oracle_phase,
+        engine_phase_counters=run.phase_history,
+        oracle_config_ids=oracle_cfgs,
+        engine_config_ids=run.config_ids,
+        engine_metrics=run.metrics(),
+        oracle_metrics=telemetry_metrics.oracle_metrics(
+            oracle_counts, all_oracle_events),
     )

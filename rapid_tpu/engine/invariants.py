@@ -24,7 +24,14 @@ even in oracle-free runs (benchmarks, sweeps, future pjit shards):
   number of decisions this tick (one), and never regresses;
 - **memsum** — the incremental membership-fingerprint sum (limb-added /
   subtracted on view changes) still equals the sum recomputed from the
-  member mask, so configuration ids cannot silently drift.
+  member mask, so configuration ids cannot silently drift;
+- **ghost_reports** — no ghost cut reports: every report cell that fills
+  this tick re-derives from a live alert that was actually in flight (or
+  from the edge-invalidation predicate — both its destination and its
+  ring observer at/above the low watermark). After a partition heals and
+  a view change resets the detector, report state must be rebuilt from
+  live traffic; a cell that reappears without a delivering alert is
+  exactly the stale-partition ghost this bit flags.
 
 Each check folds to one boolean; ``check_step`` packs them into an
 ``int32`` bitmask logged per tick in ``StepLog.inv_bits`` and surfaced as
@@ -60,6 +67,7 @@ INVARIANT_BITS = (
     ("rank_order", 3),
     ("epoch_monotone", 4),
     ("memsum", 5),
+    ("ghost_reports", 6),
 )
 
 BIT_OF = {name: bit for name, bit in INVARIANT_BITS}
@@ -118,16 +126,37 @@ def _memsum(xp, post) -> object:
     return (hi != post.memsum_hi) | (lo != post.memsum_lo)
 
 
+def _ghost_reports(xp, pre, post, settings) -> object:
+    """Every newly-filled report cell must be justified by this tick's
+    traffic: either its reporter had an alert in flight (monitor pipeline,
+    re-indexed like ``cut.deliver_reports``; churn batches justify all of
+    their destination's rings), or the edge-invalidation predicate holds —
+    the destination *and* the cell's ring observer both sit at/above the
+    low watermark on the end-of-tick counts. Cells surviving a view-change
+    reset without such a derivation are partition ghosts."""
+    added = post.reports & ~pre.reports
+    eff_obs = xp.where(post.member[:, None], post.obs_idx, post.gk_idx)
+    in_flight = xp.take_along_axis(pre.pending_deliver, eff_obs, axis=0)
+    explicit = in_flight | pre.churn_deliver[:, None]
+    counts = post.reports.sum(axis=1)
+    implicit = (counts >= settings.L)[:, None] & (counts >= settings.L)[eff_obs]
+    return (added & ~explicit & ~implicit).any()
+
+
 def check_step(xp, pre, post, *, decide_now, fast_decide, classic_decide,
-               fast_mask, classic_mask):
+               fast_mask, classic_mask, settings=None):
     """All invariant checks for one tick, packed into an i32 bitmask.
 
     ``pre``/``post`` are the EngineState before and after the tick;
     ``fast_decide``/``classic_decide`` are this tick's decision sources
     with ``fast_mask``/``classic_mask`` their proposal masks (the step
     passes the pre-tick announced proposal and the schedule's classic
-    mask). Returns 0 when every invariant holds.
+    mask). ``settings`` carries the cut watermarks for the ghost-report
+    check (``None`` — legacy callers — falls back to the defaults).
+    Returns 0 when every invariant holds.
     """
+    if settings is None:
+        from rapid_tpu.settings import DEFAULT_SETTINGS as settings
     win_mask = xp.where(classic_decide, classic_mask, fast_mask)
     flags = {
         "ring_degree": _ring_degree(xp, post),
@@ -140,6 +169,7 @@ def check_step(xp, pre, post, *, decide_now, fast_decide, classic_decide,
         "epoch_monotone": post.epoch != pre.epoch
         + decide_now.astype(xp.int32),
         "memsum": _memsum(xp, post),
+        "ghost_reports": _ghost_reports(xp, pre, post, settings),
     }
     bits = xp.int32(0)
     for name, bit in INVARIANT_BITS:

@@ -9,9 +9,10 @@ node's FD job to global tick multiples):
   checks the threshold *before* probing, so a saturated detector never
   probes again);
 - every other active slot probes its subject: the probe fails if the
-  subject or the observer is crashed, or the fault model drops the
-  observer->subject edge (the oracle's synchronous probe fast path
-  evaluates reachability at probe time with exactly these checks);
+  subject or the observer is crashed, a link window blocks the
+  observer->subject edge, or the fault model drops it probabilistically
+  (the oracle's synchronous probe fast path evaluates reachability at
+  probe time with exactly these checks);
 - failed probes increment the per-edge tombstone counter.
 
 A notification fans out to *all* rings covered by that unique subject via
@@ -26,6 +27,58 @@ from rapid_tpu.engine.state import EngineFaults, EngineState
 def crashed_at(faults: EngineFaults, tick):
     """bool [C]: crashed at ``tick`` (crash_tick <= tick)."""
     return faults.crash_tick <= tick
+
+
+def link_window_active(xp, faults: EngineFaults, tick):
+    """bool [W]: which link windows block at delivery tick ``tick``."""
+    start = faults.link_start
+    in_span = (start <= tick) & (tick < faults.link_end)
+    period = xp.maximum(faults.link_period, 1)
+    off_phase = (((tick - start) // period) % 2) == 0
+    return in_span & xp.where(faults.link_period > 0, off_phase, True)
+
+
+def link_blocked(xp, faults: EngineFaults, src_idx, dst_idx, tick):
+    """Directed link-window drop mask for broadcastable slot-index arrays.
+
+    Shape = broadcast of ``src_idx``/``dst_idx``. The number of windows is
+    a static python int (tiny), so this is a python loop of W fused masked
+    gathers — no ``[C, C]`` matrix is ever built, keeping the shared step
+    usable at 100k slots. Returns all-False when the model has no windows.
+    """
+    shape = xp.broadcast_shapes(xp.shape(src_idx), xp.shape(dst_idx))
+    blocked = xp.zeros(shape, bool)
+    if faults.n_windows == 0:
+        return blocked
+    active = link_window_active(xp, faults, tick)
+    for w in range(faults.n_windows):
+        src_w, dst_w = faults.link_src[w], faults.link_dst[w]
+        hit = src_w[src_idx] & dst_w[dst_idx]
+        hit |= faults.link_two_way[w] & dst_w[src_idx] & src_w[dst_idx]
+        blocked |= active[w] & hit
+    return blocked
+
+
+def partitioned_edge_count(xp, faults: EngineFaults, member, tick):
+    """i32 gauge: directed member->member pairs blocked by active windows.
+
+    Counted per window (overlapping windows count once each — a cheap,
+    deterministic definition that avoids materializing the [C, C] edge
+    matrix), self-edges excluded.
+    """
+    if faults.n_windows == 0:
+        return xp.int32(0)
+    active = link_window_active(xp, faults, tick)
+    total = xp.int32(0)
+    for w in range(faults.n_windows):
+        src_m = (faults.link_src[w] & member).sum().astype(xp.int32)
+        dst_m = (faults.link_dst[w] & member).sum().astype(xp.int32)
+        both = (faults.link_src[w] & faults.link_dst[w]
+                & member).sum().astype(xp.int32)
+        pairs = src_m * dst_m - both
+        two = xp.where(faults.link_two_way[w], pairs, 0)
+        total = total + xp.where(active[w], pairs + two, 0)
+    return total
 
 
 def edge_drop(xp, faults: EngineFaults, src_idx, dst_idx, uid_hi, uid_lo, tick):
@@ -68,8 +121,10 @@ def monitor_tick(xp, state: EngineState, faults: EngineFaults, settings):
     crashed = crashed_at(faults, t)
     obs_slots = xp.arange(state.fc.shape[0], dtype=xp.int32)[:, None]
     subj = state.subj_idx
+    obs_bcast = xp.broadcast_to(obs_slots, subj.shape)
     probe_fail = (crashed[subj] | crashed[:, None]
-                  | edge_drop(xp, faults, xp.broadcast_to(obs_slots, subj.shape),
+                  | link_blocked(xp, faults, obs_bcast, subj, t)
+                  | edge_drop(xp, faults, obs_bcast,
                               subj, state.uid_hi, state.uid_lo, t))
 
     at_threshold = state.fc >= settings.fd_failure_threshold

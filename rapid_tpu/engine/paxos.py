@@ -27,24 +27,32 @@ This module is the batched engine port of that machinery over the
   send-tick/deliver-next-tick pipeline as alert batches, logged as
   per-tick sender/recipient factors in ``StepLog``.
 
-Scenario envelope
------------------
+Scenario envelope (fleet kernel only)
+-------------------------------------
 The scripted contested instances (``FallbackSchedule``) reproduce the
 oracle bit-for-bit (``engine.diff.run_fallback_differential`` asserts it)
 under the conditions ``plan_fallback`` checks per scenario:
 
 - crash-free runs with a quiet alert path (no cut-detector proposals
   while a scripted instance is live) — conflicting proposals come from
-  the script, standing in for the asymmetric alert delivery that the
-  shared-detector engine cannot itself produce (see ROADMAP per-node
-  detector state);
+  the script;
 - one classic round per instance: exactly one effective timer fire, all
   other timers landing at/after the decide tick (where the oracle
-  cancels them), and no fast-round votes delivered mid-round — multi-
-  coordinator rank races stay host-side (``tests/test_paxos.py``);
+  cancels them), and no fast-round votes delivered mid-round;
 - in the fast/classic race, a timer may fire one tick before the fast
   decision: its phase-1a broadcast is counted but dead on arrival (the
   oracle's new consensus instance rejects the stale configuration id).
+
+These bounds describe what the *jitted shared-view kernel* can carry —
+one membership view and one decide latch per tick — not what the repo
+can execute. Tied first timers, mid-fast-count fires, multi-coordinator
+rank races and partition-driven asymmetric vote delivery are first-class
+scenarios for the per-slot adversary engine: build an
+``rapid_tpu.faults.AdversarySchedule`` and run it through
+``engine.diff.run_adversarial_differential``, which asserts the same
+bit-identical contract with no planner screening at all. A
+``FallbackEnvelopeError`` from ``plan_fallback`` therefore means "route
+this scenario to the adversary engine", never "unsupported".
 
 Everything here is shape-static: the schedule is a pytree of
 ``[instances, capacity]`` arrays, so it threads through ``jit`` /
@@ -68,8 +76,10 @@ _RANK_SEED = 0x72616E6B  # matches oracle.paxos.classic_rank_node_index
 
 
 class FallbackEnvelopeError(ValueError):
-    """The contested scenario leaves the envelope where the batched
-    fallback kernel is bit-identical to the oracle (module docstring)."""
+    """The contested scenario leaves the envelope of the *jitted fleet
+    kernel* (module docstring). The scenario itself is executable: run it
+    through ``engine.diff.run_adversarial_differential``, whose per-slot
+    adversary engine replays it bit-identically with no screening."""
 
 
 class FallbackSchedule(NamedTuple):
@@ -493,7 +503,9 @@ def plan_fallback(
         if min_fire < fast_decide_tick - 1:
             raise FallbackEnvelopeError(
                 f"timer fires at {min_fire}, before the fast decision at "
-                f"{fast_decide_tick} completes (out of envelope)")
+                f"{fast_decide_tick} completes — outside the fleet-kernel "
+                "envelope; run this mid-fast-count fire through "
+                "run_adversarial_differential")
         info.update(mode="fast", decide_tick=fast_decide_tick,
                     winner=fast_pid,
                     racing=bool(min_fire == fast_decide_tick - 1))
@@ -502,18 +514,22 @@ def plan_fallback(
         if len(firing) != 1:
             raise FallbackEnvelopeError(
                 f"{len(firing)} timers fire together at {min_fire}; the "
-                "envelope needs a unique first coordinator")
+                "fleet kernel needs a unique first coordinator — run tied "
+                "timers through run_adversarial_differential")
         decide = min_fire + 4  # 1a -> 1b -> 2a -> 2b -> decide
         late = [s for s, f in fires.items()
                 if s != firing[0] and f < decide]
         if late:
             raise FallbackEnvelopeError(
                 f"timers of {late} fire during the classic round "
-                f"({min_fire}..{decide}); the oracle would start a rank race")
+                f"({min_fire}..{decide}); the oracle starts a rank race the "
+                "fleet kernel cannot carry — run it through "
+                "run_adversarial_differential")
         late_votes = [s for s, (tick, _) in votes.items() if tick >= min_fire]
         if late_votes:
             raise FallbackEnvelopeError(
-                f"proposes of {late_votes} land mid-classic-round")
+                f"proposes of {late_votes} land mid-classic-round — run "
+                "them through run_adversarial_differential")
         if uids is None:
             from rapid_tpu.engine.diff import default_endpoints
             from rapid_tpu.oracle.membership_view import uid_of

@@ -31,14 +31,20 @@ membership are *dormant*: present in every array, excluded by the
 
 Scenario envelope
 -----------------
-The engine reproduces the oracle bit-for-bit for *crash-fault* scenarios
-plus scheduled join/leave churn (``rapid_tpu.engine.diff`` asserts it):
-crashes make every alive receiver see the identical alert stream, so one
-shared cut-detector state stands in for all N per-node detectors. Fault
-models that split the receiver set (partitions) need per-node detector
-state — a roadmap item. The churn envelope (what join/leave schedules the
-shared state reproduces exactly) is documented in
-``rapid_tpu.engine.churn``.
+The *shared-state* engine in this package reproduces the oracle
+bit-for-bit for crash-fault scenarios plus scheduled join/leave churn
+(``rapid_tpu.engine.diff`` asserts it): crashes make every alive receiver
+see the identical alert stream, so one shared cut-detector state stands
+in for all N per-node detectors. Fault models that split the receiver
+set — asymmetric partitions, flip-flop links, bursts straddling FD
+intervals — are handled *exactly* by the per-receiver adversary engine
+(``rapid_tpu.engine.adversary`` + ``diff.run_adversarial_differential``),
+which replicates detector/consensus state per node. The shared step still
+applies link-window masks to its failure-detector probes (``EngineFaults``
+link fields below), so link faults perturb monitoring at benchmark scale,
+but its shared cut state remains an approximation for them. The churn
+envelope (what join/leave schedules the shared state reproduces exactly)
+is documented in ``rapid_tpu.engine.churn``.
 """
 from __future__ import annotations
 
@@ -53,12 +59,21 @@ I32_MAX = np.iinfo(np.int32).max
 
 
 class EngineFaults:
-    """Device-side fault model (crash + optional probabilistic probe drop).
+    """Device-side fault model (crash + probe drop + link windows).
 
     ``crash_tick[n]`` is the tick at/after which slot ``n`` is crashed
     (``I32_MAX`` = never). ``drop_p``/``drop_seed``/``drop_targets`` mirror
     ``faults.PacketDropFault`` via the same splitmix64 Bernoulli draw, so a
     future drop-scenario differential can bit-match the oracle.
+
+    The ``link_*`` arrays window-encode ``faults.LinkWindow`` directed
+    reachability masks: window ``w`` blocks src->dst deliveries at tick
+    ``t`` when ``link_src[w, src] & link_dst[w, dst]`` and the window is
+    active (``link_start[w] <= t < link_end[w]`` and, for flip-flop
+    windows with ``link_period[w] > 0``, the off-phase
+    ``((t - start) // period) % 2 == 0``); ``link_two_way[w]`` also blocks
+    the reverse direction. ``W = 0`` (the default) compiles the link logic
+    out entirely — the step branches on the static leading dimension.
 
     Registered as a pytree with the drop *configuration* as static aux data:
     the step function branches on ``drop_p`` in Python, so it must not be a
@@ -67,26 +82,43 @@ class EngineFaults:
 
     def __init__(self, crash_tick, drop_p: float = 0.0, drop_seed: int = 0,
                  drop_targets=None, drop_ingress: bool = True,
-                 drop_egress: bool = True) -> None:
+                 drop_egress: bool = True, link_src=None, link_dst=None,
+                 link_start=None, link_end=None, link_period=None,
+                 link_two_way=None) -> None:
         self.crash_tick = crash_tick  # i32 [C]
         self.drop_p = float(drop_p)
         self.drop_seed = int(drop_seed)
         self.drop_targets = drop_targets  # bool [C] or None = everywhere
         self.drop_ingress = bool(drop_ingress)
         self.drop_egress = bool(drop_egress)
+        self.link_src = link_src          # bool [W, C] or None (W = 0)
+        self.link_dst = link_dst          # bool [W, C]
+        self.link_start = link_start      # i32 [W]
+        self.link_end = link_end          # i32 [W]
+        self.link_period = link_period    # i32 [W] (0 = static window)
+        self.link_two_way = link_two_way  # bool [W]
+
+    @property
+    def n_windows(self) -> int:
+        return 0 if self.link_src is None else int(self.link_src.shape[0])
 
     def tree_flatten(self):
-        children = (self.crash_tick, self.drop_targets)
+        children = (self.crash_tick, self.drop_targets, self.link_src,
+                    self.link_dst, self.link_start, self.link_end,
+                    self.link_period, self.link_two_way)
         aux = (self.drop_p, self.drop_seed, self.drop_targets is None,
                self.drop_ingress, self.drop_egress)
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        crash_tick, drop_targets = children
+        (crash_tick, drop_targets, link_src, link_dst, link_start,
+         link_end, link_period, link_two_way) = children
         drop_p, drop_seed, targets_none, ingress, egress = aux
         return cls(crash_tick, drop_p, drop_seed,
-                   None if targets_none else drop_targets, ingress, egress)
+                   None if targets_none else drop_targets, ingress, egress,
+                   link_src, link_dst, link_start, link_end, link_period,
+                   link_two_way)
 
 
 def _register_faults() -> None:
@@ -216,6 +248,12 @@ class StepLog(NamedTuple):
     quorum: object                    # i32: fast quorum at the vote count
     epoch: object                     # i32: config epoch after this tick
     churn_injected: object            # i32: churn alerts enqueued this tick
+    partitioned_edges: object         # i32: directed member pairs blocked by
+                                      # active link windows (per window, self
+                                      # edges excluded; 0 when W = 0)
+    link_dropped: object              # i32: deliveries dropped by link masks
+                                      # this tick (0 in the shared step,
+                                      # whose delivery path is crash-only)
     # --- classic-Paxos fallback factors + gauges ------------------------
     pxvote_senders: object            # i32: scripted fast-vote broadcasters
     pxvote_recipients: object         # i32
@@ -356,3 +394,38 @@ def crash_faults(crash_ticks: Sequence[int]) -> EngineFaults:
     arr = np.array([I32_MAX if t is None else t for t in crash_ticks],
                    dtype=np.int32)
     return EngineFaults(crash_tick=jnp.asarray(arr))
+
+
+def link_faults(crash_ticks: Sequence[int], windows,
+                capacity: int) -> EngineFaults:
+    """EngineFaults for crashes plus ``faults.LinkWindow`` link masks.
+
+    ``windows`` is a sequence of slot-indexed ``LinkWindow``s; an empty
+    sequence degenerates to ``crash_faults`` (W = 0, link logic compiled
+    out).
+    """
+    import jax.numpy as jnp
+
+    base = crash_faults(crash_ticks)
+    windows = tuple(windows)
+    if not windows:
+        return base
+    w = len(windows)
+    src = np.zeros((w, capacity), bool)
+    dst = np.zeros((w, capacity), bool)
+    start = np.zeros(w, np.int32)
+    end = np.zeros(w, np.int32)
+    period = np.zeros(w, np.int32)
+    two_way = np.zeros(w, bool)
+    for i, win in enumerate(windows):
+        src[i, list(win.src_slots)] = True
+        dst[i, list(win.dst_slots)] = True
+        start[i] = win.start_tick
+        end[i] = min(win.end_tick, I32_MAX)
+        period[i] = win.period_ticks
+        two_way[i] = win.two_way
+    return EngineFaults(
+        crash_tick=base.crash_tick,
+        link_src=jnp.asarray(src), link_dst=jnp.asarray(dst),
+        link_start=jnp.asarray(start), link_end=jnp.asarray(end),
+        link_period=jnp.asarray(period), link_two_way=jnp.asarray(two_way))

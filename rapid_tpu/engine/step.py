@@ -315,6 +315,7 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
             classic_decide=sc_decide,
             fast_mask=state.proposal,
             classic_mask=sc_mask,
+            settings=settings,
         )
     else:
         inv_bits = jnp.int32(0)
@@ -353,6 +354,9 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         quorum=vote_quorum,
         epoch=new_state.epoch,
         churn_injected=churn_injected,
+        partitioned_edges=monitor.partitioned_edge_count(
+            jnp, faults, new_state.member, t),
+        link_dropped=jnp.int32(0),
         pxvote_senders=px_counts["pxvote_senders"],
         pxvote_recipients=px_counts["pxvote_recipients"],
         px1a_senders=px_counts["px1a_senders"],

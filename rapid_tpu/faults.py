@@ -221,6 +221,254 @@ def correlated_rack_failure(endpoints: Sequence[Endpoint], rack_of: Callable[[En
 
 
 # ---------------------------------------------------------------------------
+# Adversary schedules: slot-indexed unscripted fault programs
+# ---------------------------------------------------------------------------
+#
+# The adversarial differential (``engine.diff.run_adversarial_differential``)
+# does not pre-approve scenarios; it takes a *schedule* — crash ticks, a set
+# of directed link windows, and optional scripted consensus proposes — in
+# slot coordinates and runs it through both the oracle (as a ``FaultModel``)
+# and the per-receiver device engine (as window-encoded mask arrays on
+# ``engine.state.EngineFaults``). ``LinkWindow`` is the single normal form
+# every link-level model above lowers to: a one-way partition is one window
+# with ``period_ticks=0``, a flip-flop link is one window with
+# ``period_ticks>0`` (off-phase first, like ``FlipFlopFault``).
+
+_NEVER_TICK = (1 << 31) - 1  # int32-safe "never" sentinel
+
+
+@dataclass(frozen=True)
+class LinkWindow:
+    """One directed reachability window in slot coordinates.
+
+    While *active* — ``start_tick <= t < end_tick`` and, when
+    ``period_ticks > 0``, the flip-flop off-phase
+    ``((t - start_tick) // period_ticks) % 2 == 0`` — messages delivered at
+    tick ``t`` from a slot in ``src_slots`` to a slot in ``dst_slots`` are
+    dropped (``two_way`` additionally drops the reverse direction). Masks
+    are evaluated at the *delivery* tick, like every edge rule in this
+    module.
+    """
+
+    src_slots: FrozenSet[int] = frozenset()
+    dst_slots: FrozenSet[int] = frozenset()
+    start_tick: int = 0
+    end_tick: int = _NEVER_TICK
+    period_ticks: int = 0
+    two_way: bool = False
+
+    def active(self, tick: int) -> bool:
+        if not (self.start_tick <= tick < self.end_tick):
+            return False
+        if self.period_ticks <= 0:
+            return True
+        return ((tick - self.start_tick) // self.period_ticks) % 2 == 0
+
+    def blocks(self, src_slot: int, dst_slot: int, tick: int) -> bool:
+        if not self.active(tick):
+            return False
+        if src_slot in self.src_slots and dst_slot in self.dst_slots:
+            return True
+        return self.two_way and src_slot in self.dst_slots and \
+            dst_slot in self.src_slots
+
+
+@dataclass(frozen=True)
+class ScriptedPropose:
+    """One scripted consensus propose: slot ``slot`` proposes the removal
+    of ``proposal`` (ascending slot tuple) at scheduler tick ``tick`` with
+    an explicit classic-fallback timer delay of ``delay_ticks``."""
+
+    slot: int
+    tick: int
+    proposal: Tuple[int, ...]
+    delay_ticks: int
+
+
+@dataclass(frozen=True)
+class AdversarySchedule:
+    """A seeded, unscripted fault program over an ``n``-slot universe.
+
+    ``crashes`` maps slot -> fail-stop tick; ``windows`` are directed link
+    windows; ``proposes`` are scripted consensus proposes (mid-fast-count
+    fires, tied timers and rank races arise from these plus the organic
+    jittered timers — nothing here is pre-screened). ``seed`` feeds the
+    per-node jitter rng on both sides of the differential.
+    """
+
+    n: int
+    crashes: Tuple[Tuple[int, int], ...] = ()
+    windows: Tuple[LinkWindow, ...] = ()
+    proposes: Tuple[ScriptedPropose, ...] = ()
+    seed: int = 0
+
+    def crash_tick_array(self) -> np.ndarray:
+        ticks = np.full(self.n, _NEVER_TICK, dtype=np.int64)
+        for slot, tick in self.crashes:
+            ticks[slot] = min(ticks[slot], tick)
+        return ticks
+
+    def fault_model(self, endpoints: Sequence[Endpoint]) -> FaultModel:
+        """The oracle-side ``FaultModel`` equivalent of this schedule."""
+        crash = CrashFault({endpoints[slot]: tick
+                            for slot, tick in self.crashes})
+        return ComposedFault([crash, LinkWindowFault(self.windows)])
+
+
+class LinkWindowFault(FaultModel):
+    """Oracle-side edge rule for a tuple of slot-indexed ``LinkWindow``s.
+
+    Slot resolution uses the ``nX.sim`` convention of
+    ``engine.diff.default_endpoints``; ``edge_mask`` is array-native so the
+    engine's shared step can also drive it.
+    """
+
+    def __init__(self, windows: Sequence[LinkWindow]) -> None:
+        self.windows = tuple(windows)
+
+    @staticmethod
+    def _slot(endpoint: Endpoint) -> int:
+        host = endpoint.hostname
+        return int(host[1:host.index(".")]) if host.startswith("n") else -1
+
+    def edge_ok(self, src: Endpoint, dst: Endpoint, tick: int) -> bool:
+        s, d = self._slot(src), self._slot(dst)
+        return not any(w.blocks(s, d, tick) for w in self.windows)
+
+    def edge_mask(self, endpoints, tick):
+        n = len(endpoints)
+        mask = np.ones((n, n), dtype=bool)
+        slots = np.array([self._slot(e) for e in endpoints])
+        for w in self.windows:
+            if not w.active(tick):
+                continue
+            s = np.isin(slots, list(w.src_slots))
+            d = np.isin(slots, list(w.dst_slots))
+            blocked = s[:, None] & d[None, :]
+            if w.two_way:
+                blocked |= d[:, None] & s[None, :]
+            mask &= ~blocked
+        return mask
+
+
+def link_windows_of(model: FaultModel,
+                    endpoints: Sequence[Endpoint]) -> Optional[List[LinkWindow]]:
+    """Lower a ``FaultModel``'s link-level rules to ``LinkWindow`` normal
+    form (slot coordinates follow ``endpoints`` order), or ``None`` when the
+    model has edge rules no window set reproduces exactly (probabilistic
+    drops)."""
+    slot_of = {e: i for i, e in enumerate(endpoints)}
+
+    def slots(es) -> FrozenSet[int]:
+        return frozenset(slot_of[e] for e in es if e in slot_of)
+
+    if isinstance(model, ComposedFault):
+        out: List[LinkWindow] = []
+        for m in model.models:
+            sub = link_windows_of(m, endpoints)
+            if sub is None:
+                return None
+            out += sub
+        return out
+    if isinstance(model, LinkWindowFault):
+        return list(model.windows)
+    if isinstance(model, OneWayPartitionFault):
+        return [LinkWindow(src_slots=slots(model.from_set),
+                           dst_slots=slots(model.to_set),
+                           start_tick=model.start_tick,
+                           end_tick=min(model.end_tick, _NEVER_TICK))]
+    if isinstance(model, FlipFlopFault):
+        t = slots(model.targets)
+        others = frozenset(range(len(endpoints))) - t
+        return [LinkWindow(src_slots=others, dst_slots=t,
+                           start_tick=model.start_tick,
+                           period_ticks=model.period_ticks,
+                           two_way=not model.one_way)]
+    if isinstance(model, (CrashFault,)) or type(model) is FaultModel:
+        return []  # no edge rules
+    return None
+
+
+def validate_schedule(schedule: AdversarySchedule) -> None:
+    """Genuine input validation only — nothing scenario-shaped is rejected.
+
+    Slots must exist, crashes and proposes must land at tick >= 1 (tick 0
+    is the boot snapshot), proposals must be non-empty ascending slot
+    tuples, explicit delays non-negative, and at most one scripted propose
+    per slot (the device schedule carries one scripted timer slot per node
+    next to the organic one).
+    """
+    n = schedule.n
+    for slot, tick in schedule.crashes:
+        if not 0 <= slot < n:
+            raise ValueError(f"crash slot {slot} outside universe of {n}")
+        if tick < 1:
+            raise ValueError(f"crash tick {tick} must be >= 1")
+    for w in schedule.windows:
+        for s in w.src_slots | w.dst_slots:
+            if not 0 <= s < n:
+                raise ValueError(f"window slot {s} outside universe of {n}")
+        if w.period_ticks < 0:
+            raise ValueError("window period_ticks must be >= 0")
+    per_slot: Dict[int, int] = {}
+    seen: Set[Tuple[int, int]] = set()
+    for p in schedule.proposes:
+        if not 0 <= p.slot < n:
+            raise ValueError(f"propose slot {p.slot} outside universe of {n}")
+        if p.tick < 1:
+            raise ValueError(f"propose tick {p.tick} must be >= 1")
+        if not p.proposal or list(p.proposal) != sorted(set(p.proposal)):
+            raise ValueError("proposal must be a non-empty ascending tuple")
+        if any(not 0 <= s < n for s in p.proposal):
+            raise ValueError("proposal slot outside universe")
+        if p.delay_ticks < 0:
+            raise ValueError("delay_ticks must be >= 0")
+        if (p.slot, p.tick) in seen:
+            raise ValueError(f"two scripted proposes on slot {p.slot} at "
+                             f"tick {p.tick}")
+        seen.add((p.slot, p.tick))
+        per_slot[p.slot] = per_slot.get(p.slot, 0) + 1
+        if per_slot[p.slot] > 1:
+            raise ValueError(f"more than one scripted propose on slot "
+                             f"{p.slot} (device schedule capacity)")
+
+
+def random_adversary_schedule(n: int, seed: int, ticks: int,
+                              fd_interval: int = 10) -> AdversarySchedule:
+    """Sample an unscripted fault schedule: a crash burst that may straddle
+    an FD-interval boundary, a one-way partition of a random ring subset,
+    and (sometimes) a flip-flop link window. Deterministic in ``seed``."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    crashes: List[Tuple[int, int]] = []
+    n_crash = rng.randint(1, max(1, n // 16))
+    burst_start = rng.randint(1, max(1, fd_interval))
+    for slot in rng.sample(range(n), n_crash):
+        # Half the crashes land after the next FD boundary -> straddling.
+        tick = burst_start + (fd_interval if rng.random() < 0.5 else 0)
+        crashes.append((slot, tick))
+    windows: List[LinkWindow] = []
+    if rng.random() < 0.75:
+        size = rng.randint(2, max(2, n // 4))
+        iso = frozenset(rng.sample(range(n), size))
+        rest = frozenset(range(n)) - iso
+        windows.append(LinkWindow(src_slots=rest, dst_slots=iso,
+                                  start_tick=rng.randint(1, fd_interval)))
+    if rng.random() < 0.25:
+        size = rng.randint(1, max(1, n // 8))
+        t = frozenset(rng.sample(range(n), size))
+        windows.append(LinkWindow(
+            src_slots=frozenset(range(n)) - t, dst_slots=t,
+            start_tick=rng.randint(1, ticks // 2),
+            period_ticks=rng.randint(2, 4) * fd_interval))
+    schedule = AdversarySchedule(n=n, crashes=tuple(sorted(crashes)),
+                                 windows=tuple(windows), seed=seed)
+    validate_schedule(schedule)
+    return schedule
+
+
+# ---------------------------------------------------------------------------
 # Deterministic Bernoulli sampling shared host/device
 # ---------------------------------------------------------------------------
 

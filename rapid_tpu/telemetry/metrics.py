@@ -56,6 +56,12 @@ class TickMetrics:
     vote_tally: int = UNOBSERVED
     quorum: int = UNOBSERVED
     churn_injected: int = UNOBSERVED
+    # fault-context gauges: directed member edges blocked by active link
+    # windows and deliveries dropped by those masks this tick, so
+    # divergence forensics can name the fault context of the first
+    # divergent tick. Engine-derived; UNOBSERVED on the oracle.
+    partitioned_edges: int = UNOBSERVED
+    link_dropped: int = UNOBSERVED
     # on-device invariant-monitor bitmask (engine.invariants.describe_bits
     # decodes it); 0 on every clean tick, constant 0 when the run was
     # compiled with Settings.invariant_checks=False, UNOBSERVED on the
@@ -122,6 +128,8 @@ def engine_metrics(logs) -> List[TickMetrics]:
     tally = np.asarray(logs.vote_tally)
     quorum = np.asarray(logs.quorum)
     churned = np.asarray(logs.churn_injected)
+    part_edges = np.asarray(logs.partitioned_edges)
+    link_dropped = np.asarray(logs.link_dropped)
     inv_bits = np.asarray(logs.inv_bits)
     timers_armed = np.asarray(logs.px_timers_armed)
     coord_round = np.asarray(logs.px_coord_round)
@@ -138,6 +146,8 @@ def engine_metrics(logs) -> List[TickMetrics]:
             vote_tally=int(tally[i]),
             quorum=int(quorum[i]),
             churn_injected=int(churned[i]),
+            partitioned_edges=int(part_edges[i]),
+            link_dropped=int(link_dropped[i]),
             invariant_violations=int(inv_bits[i]),
             px_timers_armed=int(timers_armed[i]),
             px_coord_round=int(coord_round[i]),
@@ -231,6 +241,10 @@ class RunSummary:
     # phase1b, phase2a, phase2b); all-zero when the run had no fallback
     # schedule (UNOBSERVED gauges are excluded from the sums).
     fallback_phase_sent: Dict[str, int] = field(default_factory=dict)
+    # fault-context totals: peak per-tick partitioned-edge gauge and total
+    # link-mask message drops over the run (0 when unobserved/healthy).
+    max_partitioned_edges: int = 0
+    total_link_dropped: int = 0
 
     def as_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -256,10 +270,16 @@ def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
                  ("phase2b", "px_phase2b_sent"))
     px_totals = {phase: 0 for phase, _ in px_fields}
     inv_ticks = 0
+    max_part_edges = 0
+    link_dropped_total = 0
 
     for m in metrics:
         if m.invariant_violations > 0:
             inv_ticks += 1
+        if m.partitioned_edges > max_part_edges:
+            max_part_edges = m.partitioned_edges
+        if m.link_dropped > 0:
+            link_dropped_total += m.link_dropped
         for f in COUNTER_FIELDS:
             totals[f] += getattr(m, f)
         for phase, attr in px_fields:
@@ -310,4 +330,6 @@ def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
         total_probes_failed=totals["probes_failed"],
         invariant_violations=inv_ticks,
         fallback_phase_sent=px_totals,
+        max_partitioned_edges=max_part_edges,
+        total_link_dropped=link_dropped_total,
     )

@@ -25,7 +25,7 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 #: RunSummary.as_dict() — the per-run "telemetry" block.
 TELEMETRY_SPEC = {
@@ -45,6 +45,8 @@ TELEMETRY_SPEC = {
     "total_probes_failed": (int,),
     "invariant_violations": (int,),
     "fallback_phase_sent": (dict,),
+    "max_partitioned_edges": (int,),
+    "total_link_dropped": (int,),
 }
 
 #: Keys of the fallback_phase_sent block (matches engine.diff._PX_CLASSES
@@ -197,7 +199,7 @@ def validate_bench_payload(payload) -> List[str]:
     if payload.get("bench") == "kernel_profile_sweep":
         return errors + validate_profile_payload(payload)
     if payload.get("bench") == "engine_tick_suite":
-        for key in ("steady", "churn", "contested"):
+        for key in ("steady", "churn", "contested", "partition"):
             if key not in payload:
                 errors.append(f"payload.{key}: missing")
             else:

@@ -53,7 +53,8 @@ PROTOCOL_TELEMETRY_KEYS = (
     "ticks_to_first_decide", "messages_per_view_change", "total_sent",
     "total_delivered", "total_dropped", "total_timeouts",
     "total_probes_sent", "total_probes_failed", "invariant_violations",
-    "fallback_phase_sent", "view_changes",
+    "fallback_phase_sent", "view_changes", "max_partitioned_edges",
+    "total_link_dropped",
 )
 
 
@@ -109,7 +110,7 @@ def compare_payloads(current: Dict, baseline: Dict,
     if cur_kind == "engine_tick_suite":
         errors: List[str] = []
         warnings: List[str] = []
-        for key in ("steady", "churn", "contested"):
+        for key in ("steady", "churn", "contested", "partition"):
             e, w = compare_run(current.get(key) or {},
                                baseline.get(key) or {},
                                f"payload.{key}", tps_tolerance)

@@ -51,6 +51,22 @@ if [ "$rc" -eq 0 ]; then
     fi
 fi
 
+# Fault-adversary smoke: the one-way-partition scenario must run the
+# host discrete-event engine against the oracle end to end — the run
+# itself asserts bit-identity before emitting counts — and the payload
+# must carry the partition gauges the schema requires.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/bench_engine.py \
+            --scenario partition --n 48 --ticks 300 \
+            --out /tmp/_t1_partition.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_partition.json; then
+        echo PARTITION_SMOKE=ok
+    else
+        echo PARTITION_SMOKE=failed
+        rc=1
+    fi
+fi
+
 # Kernel-profile smoke: the per-kernel cost observatory must lower every
 # sub-kernel and emit a schema-valid dominance report (small N, few
 # repeats — the full 1k/10k/100k sweep is run manually; see

@@ -1,0 +1,192 @@
+"""On-device fault adversary vs the oracle (engine.adversary).
+
+The acceptance contract: ``run_adversarial_differential`` accepts seeded,
+unscripted fault schedules — asymmetric partitions, flip-flop links, tied
+timers, mid-fast-count fires, crash bursts straddling an FD-interval
+boundary — with no planner pre-rejection, and proves the per-slot engine
+bit-identical to the oracle: every slot's event stream, total and
+per-phase message counters, and every slot's final configuration id, at
+N=64 and N=256, including a classic-Paxos fallback decided under a
+one-way partition. Divergences surface through the forensics-enabled
+``assert_identical`` with partition gauges in the report context.
+"""
+import pytest
+
+from rapid_tpu.engine.diff import run_adversarial_differential
+from rapid_tpu.faults import (
+    AdversarySchedule,
+    LinkWindow,
+    ScriptedPropose,
+    random_adversary_schedule,
+    validate_schedule,
+)
+from rapid_tpu.telemetry.forensics import DivergenceError
+
+
+def _phase_total(res, key):
+    return sum(d[key] for d in res.engine_phase_counters)
+
+
+def _view_changes(res, slot):
+    return [e for e in res.engine_events_by_slot[slot]
+            if e.kind == "view_change"]
+
+
+# ---------------------------------------------------------------------------
+# crashes
+# ---------------------------------------------------------------------------
+
+
+def test_single_crash_bit_identical():
+    sched = AdversarySchedule(n=8, crashes=((3, 5),), seed=1)
+    res = run_adversarial_differential(sched, 160)
+    res.assert_identical()
+    # Every survivor converges on the same post-removal view; the crashed
+    # slot records nothing and its view freezes at the boot config.
+    survivor_cfgs = {res.engine_config_ids[s] for s in range(8) if s != 3}
+    assert len(survivor_cfgs) == 1
+    assert res.engine_config_ids[3] not in survivor_cfgs
+    assert not res.engine_events_by_slot[3]
+    assert all(_view_changes(res, s) for s in range(8) if s != 3)
+
+
+@pytest.mark.parametrize("n,crashes", [
+    (64, ((1, 5), (2, 5), (40, 15), (41, 15))),
+    (256, ((1, 5), (2, 5), (3, 5), (4, 5),
+           (200, 15), (201, 15), (202, 15), (203, 15))),
+])
+def test_straddling_burst_two_view_changes(n, crashes):
+    """A crash burst straddling an FD-interval boundary is detected in two
+    waves and must produce two view changes — the documented stale-state
+    gap in the old fleet planner, now run exactly."""
+    sched = AdversarySchedule(n=n, crashes=crashes, seed=2)
+    res = run_adversarial_differential(sched, 260)
+    res.assert_identical()
+    crashed = {s for s, _ in crashes}
+    survivor = next(s for s in range(n) if s not in crashed)
+    vcs = _view_changes(res, survivor)
+    assert len(vcs) == 2
+    assert vcs[0].tick < vcs[1].tick
+    assert vcs[0].config_id != vcs[1].config_id
+    removed = {s for vc in vcs for s in vc.slots}
+    assert removed == crashed
+
+
+# ---------------------------------------------------------------------------
+# asymmetric partitions
+# ---------------------------------------------------------------------------
+
+
+def _one_way_partition(n, iso, start=3):
+    """Block rest->iso only: rest-side observers' probes to iso subjects
+    fail (detection), while iso nodes — whose own probes still succeed —
+    stay quiet and never hear the removal votes."""
+    rest = frozenset(range(n)) - iso
+    return LinkWindow(src_slots=rest, dst_slots=iso, start_tick=start)
+
+
+def test_one_way_partition_classic_fallback_n64():
+    """20 of 64 slots isolated one-way: only 44 fast votes circulate,
+    short of the fast quorum of 49, so the decision must come from the
+    organic jittered classic-Paxos fallback — under the partition."""
+    n, iso = 64, frozenset(range(44, 64))
+    sched = AdversarySchedule(
+        n=n, windows=(_one_way_partition(n, iso),), seed=7)
+    res = run_adversarial_differential(sched, 300)
+    res.assert_identical()
+    assert _phase_total(res, "phase1a_sent") > 0
+    rest_cfgs = {res.engine_config_ids[s] for s in sorted(set(range(n)) - iso)}
+    iso_cfgs = {res.engine_config_ids[s] for s in sorted(iso)}
+    # The reachable side converges on one new view; the isolated side
+    # never hears about it and keeps the boot view.
+    assert len(rest_cfgs) == 1 and len(iso_cfgs) == 1
+    assert rest_cfgs != iso_cfgs
+    survivor = 0
+    assert {s for vc in _view_changes(res, survivor) for s in vc.slots} == iso
+
+
+def test_one_way_partition_fast_decide_n256():
+    """40 of 256 slots isolated: 216 reachable voters clear the fast
+    quorum of 193, so the fast round decides despite the partition."""
+    n, iso = 256, frozenset(range(216, 256))
+    sched = AdversarySchedule(
+        n=n, windows=(_one_way_partition(n, iso),), seed=9)
+    res = run_adversarial_differential(sched, 240)
+    res.assert_identical()
+    survivor = 0
+    vcs = _view_changes(res, survivor)
+    assert vcs and {s for vc in vcs for s in vc.slots} == iso
+    assert all(not res.engine_events_by_slot[s] for s in iso)
+
+
+def test_flip_flop_link_window_bit_identical():
+    """A periodically healing link plus a crash: reachability flips every
+    7 ticks, exercising delivery-tick mask evaluation on both sides."""
+    win = LinkWindow(src_slots=frozenset({0, 1, 2}),
+                     dst_slots=frozenset({5, 6}),
+                     start_tick=4, end_tick=140, period_ticks=7,
+                     two_way=True)
+    sched = AdversarySchedule(n=8, crashes=((6, 9),), windows=(win,),
+                              seed=13)
+    res = run_adversarial_differential(sched, 220)
+    res.assert_identical()
+
+
+def test_partition_gauges_surface_in_engine_metrics():
+    n, iso = 8, frozenset({6, 7})
+    sched = AdversarySchedule(
+        n=n, windows=(_one_way_partition(n, iso),), seed=3)
+    res = run_adversarial_differential(sched, 200)
+    res.assert_identical()
+    rows = res.engine_metrics
+    assert max(r.partitioned_edges for r in rows) == len(iso) * (n - len(iso))
+    assert sum(r.link_dropped for r in rows) > 0
+
+
+# ---------------------------------------------------------------------------
+# unscripted seeded schedules
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_schedule_bit_identical(seed):
+    sched = random_adversary_schedule(16, seed, 300)
+    run_adversarial_differential(sched, 300).assert_identical()
+
+
+def test_scripted_proposes_mix_with_organic_faults():
+    """Scripted tied-delay proposes racing a crash-driven organic cut."""
+    proposes = (ScriptedPropose(slot=0, tick=20, proposal=(5,),
+                                delay_ticks=12),
+                ScriptedPropose(slot=1, tick=20, proposal=(6,),
+                                delay_ticks=12))
+    sched = AdversarySchedule(n=8, crashes=((7, 25),), proposes=proposes,
+                              seed=21)
+    res = run_adversarial_differential(sched, 200)
+    res.assert_identical()
+
+
+# ---------------------------------------------------------------------------
+# validation and forensics
+# ---------------------------------------------------------------------------
+
+
+def test_validate_schedule_genuine_input_errors_only():
+    with pytest.raises(ValueError, match="outside universe"):
+        validate_schedule(AdversarySchedule(n=4, crashes=((9, 5),)))
+    with pytest.raises(ValueError, match=">= 1"):
+        validate_schedule(AdversarySchedule(n=4, crashes=((1, 0),)))
+    dup = (ScriptedPropose(slot=2, tick=5, proposal=(0,), delay_ticks=3),
+           ScriptedPropose(slot=2, tick=9, proposal=(1,), delay_ticks=3))
+    with pytest.raises(ValueError, match="one scripted propose"):
+        validate_schedule(AdversarySchedule(n=4, proposes=dup))
+
+
+def test_divergence_report_names_slot_and_writes_artifact(tmp_path):
+    sched = AdversarySchedule(n=8, crashes=((2, 5),), seed=5)
+    res = run_adversarial_differential(sched, 160)
+    res.engine_config_ids[0] ^= 1  # simulate a per-slot view divergence
+    artifact = str(tmp_path / "divergence.jsonl")
+    with pytest.raises(DivergenceError, match="slot0.config_id"):
+        res.assert_identical(artifact=artifact)
+    assert (tmp_path / "divergence.jsonl").exists()

@@ -15,8 +15,10 @@ from rapid_tpu import hashing
 from rapid_tpu.engine.diff import (
     default_endpoints,
     engine_events,
+    run_adversarial_differential,
     run_fallback_differential,
 )
+from rapid_tpu.faults import AdversarySchedule, ScriptedPropose
 from rapid_tpu.engine.paxos import (
     FallbackEnvelopeError,
     classic_rank_index,
@@ -124,7 +126,12 @@ def test_fallback_phase_totals_reach_run_summary():
 
 
 # ---------------------------------------------------------------------------
-# planner envelope rejections
+# fleet-kernel envelope rejections -> adversary-engine exact runs
+#
+# The fleet kernel's planner still guards itself, but a rejection is now a
+# routing hint, not a dead end: every scenario it refuses must run
+# bit-identically through ``run_adversarial_differential``. Each test below
+# asserts both halves of that contract.
 # ---------------------------------------------------------------------------
 
 
@@ -135,7 +142,21 @@ def _base_scenario(n=8):
     return values, votes, delays
 
 
-def test_plan_rejects_timer_firing_mid_fast_count():
+def _adversary_equivalent(n, values, votes, delays, seed=11):
+    """Lower a planner-style (values, votes, delays) scenario to the
+    equivalent unscripted ``AdversarySchedule``."""
+    proposes = tuple(
+        ScriptedPropose(slot=s, tick=tick, proposal=tuple(values[pid]),
+                        delay_ticks=delays[s])
+        for s, (tick, pid) in sorted(votes.items()))
+    return AdversarySchedule(n=n, proposes=proposes, seed=seed)
+
+
+def _phase_total(res, key):
+    return sum(d[key] for d in res.engine_phase_counters)
+
+
+def test_timer_firing_mid_fast_count_runs_exactly():
     n = 8
     q = n - (n - 1) // 4
     values = [[0], [1]]
@@ -145,20 +166,41 @@ def test_plan_rejects_timer_firing_mid_fast_count():
     delays[0] = 2           # fires at 8, while votes are still arriving
     with pytest.raises(FallbackEnvelopeError, match="before the fast"):
         plan_fallback(n, values, votes, delays, SETTINGS)
+    res = run_adversarial_differential(
+        _adversary_equivalent(n, values, votes, delays), 120)
+    res.assert_identical()
+    # The mid-count fire really started a classic round before the fast
+    # quorum completed, and the view change still landed on every survivor.
+    assert _phase_total(res, "phase1a_sent") > 0
+    assert any(ev.kind == "view_change"
+               for ev in res.engine_events_by_slot[1])
 
 
-def test_plan_rejects_tied_first_timers():
+def test_tied_first_timers_run_exactly():
     values, votes, delays = _base_scenario()
     delays[1] = delays[0]
     with pytest.raises(FallbackEnvelopeError, match="unique first"):
         plan_fallback(8, values, votes, delays, SETTINGS)
+    res = run_adversarial_differential(
+        _adversary_equivalent(8, values, votes, delays), 120)
+    res.assert_identical()
+    # Both tied coordinators broadcast 1a; rank order breaks the tie.
+    assert _phase_total(res, "phase1a_sent") >= 16
+    assert any(any(ev.kind == "view_change" for ev in evs)
+               for evs in res.engine_events_by_slot)
 
 
-def test_plan_rejects_second_fire_during_classic_round():
+def test_second_fire_during_classic_round_runs_exactly():
     values, votes, delays = _base_scenario()
     delays[1] = delays[0] + 2  # lands between 1a and the decide
     with pytest.raises(FallbackEnvelopeError, match="rank race"):
         plan_fallback(8, values, votes, delays, SETTINGS)
+    res = run_adversarial_differential(
+        _adversary_equivalent(8, values, votes, delays), 120)
+    res.assert_identical()
+    assert _phase_total(res, "phase1a_sent") >= 16
+    assert any(any(ev.kind == "view_change" for ev in evs)
+               for evs in res.engine_events_by_slot)
 
 
 def test_plan_rejects_pre_start_propose_tick():

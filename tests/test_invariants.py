@@ -53,10 +53,12 @@ def bit(name: str) -> int:
 def test_bit_registry_is_append_only_contract():
     # Bit positions are part of the telemetry contract; renumbering would
     # silently re-label persisted BENCH artifacts.
-    assert [b for _, b in invariants.INVARIANT_BITS] == [0, 1, 2, 3, 4, 5]
+    assert [b for _, b in invariants.INVARIANT_BITS] == \
+        [0, 1, 2, 3, 4, 5, 6]
     assert BIT_OF["ring_degree"] == 0
     assert BIT_OF["memsum"] == 5
-    assert ALL_BITS == 0b111111
+    assert BIT_OF["ghost_reports"] == 6
+    assert ALL_BITS == 0b1111111
 
 
 def test_describe_bits_decodes_in_bit_order():
@@ -233,6 +235,44 @@ def test_check_step_double_decide_flags_unique_decide():
     # a legitimate single-source decision passes
     assert not _step_bits(pre, post, decide=True, fast=True) \
         & bit("unique_decide")
+
+
+def test_check_step_ghost_report_flags_ghost_reports():
+    # A report cell filling with no alert in flight and no invalidation
+    # derivation is exactly the stale-partition ghost bit 6 flags.
+    base = boot(8, replace(SETTINGS, seed=7015))
+    post = base._replace(reports=base.reports.at[0, 0].set(True))
+    assert _step_bits(base, post) & bit("ghost_reports")
+    # ...a cell whose ring observer had an alert in flight is legitimate
+    obs0 = int(np.asarray(base.obs_idx)[0, 0])
+    pre = base._replace(
+        pending_deliver=base.pending_deliver.at[obs0, 0].set(True))
+    assert not _step_bits(pre, post._replace(
+        pending_deliver=pre.pending_deliver)) & bit("ghost_reports")
+    # ...and so is one derived by edge invalidation: destination and ring
+    # observer both already sit at the low watermark.
+    obs4 = int(np.asarray(base.obs_idx)[0, 4])
+    reports = base.reports.at[0, :4].set(True).at[obs4, :4].set(True)
+    pre = base._replace(reports=reports)
+    impl = pre._replace(reports=reports.at[0, 4].set(True))
+    assert not _step_bits(pre, impl) & bit("ghost_reports")
+
+
+def test_ghost_report_corruption_flagged_in_simulated_run():
+    # Seed a crash run whose delivered alerts corrupt: spoof one report
+    # cell into the state mid-flight by pre-filling a cell the monitor can
+    # prove nothing delivered — tick 1 post-state of a doctored pre-state.
+    n = 64
+    settings = replace(SETTINGS, seed=7016)
+    state = boot(n, settings)
+    doctored = state._replace(reports=state.reports.at[2, 3].set(True))
+    bits = int(check_step(
+        jnp, state, doctored,
+        decide_now=jnp.asarray(False), fast_decide=jnp.asarray(False),
+        classic_decide=jnp.asarray(False), fast_mask=state.proposal,
+        classic_mask=jnp.zeros(n, bool), settings=settings))
+    assert bits == bit("ghost_reports")
+    assert describe_bits(bits) == ["ghost_reports"]
 
 
 # ---------------------------------------------------------------------------

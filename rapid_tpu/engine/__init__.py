@@ -39,7 +39,8 @@ from rapid_tpu.engine.step import (
     step,
     trace_count,
 )
-from rapid_tpu.engine.topology import build_topology
+from rapid_tpu.engine.topology import (build_topology, rank_and_insert,
+                                       ring_permutations)
 
 __all__ = [
     "ChurnEnvelopeError",
@@ -58,7 +59,9 @@ __all__ = [
     "engine_step",
     "init_state",
     "plan_churn",
+    "rank_and_insert",
     "reset_trace_count",
+    "ring_permutations",
     "simulate",
     "state_config_id",
     "step",

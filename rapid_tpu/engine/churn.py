@@ -12,8 +12,14 @@ mask. What moves between host and device:
   (flush after one quiescent batching window, deliver one hop later,
   aggregate, announce, fast-round vote, decide) and a decided proposal
   triggers the full view reconfiguration *inside* the jitted scan:
-  membership XOR, fingerprint-sum updates, per-ring topology rebuild,
-  detector/cut/consensus reset scoped by the epoch bump.
+  membership XOR, fingerprint-sum updates, a sort-free re-scan of the
+  static ring order, detector/cut/consensus reset scoped by the epoch
+  bump. UUID-retry identifier redraws ride the same schedule
+  (``redraw_*`` fields, applied by ``apply_redraws``): at the scheduled
+  tick the dormant slot's identity limbs are swapped in and its ring
+  position updated by ``topology.rank_and_insert`` — still no sort in
+  the jitted path. Schedules without redraws leave the ``redraw_*``
+  fields ``None`` and compile the phase out entirely.
 
 - **Host** (``plan_churn``): everything the oracle does with *messages
   that are not alert broadcasts* — the two-phase join gatekeeping
@@ -67,7 +73,8 @@ from rapid_tpu import hashing
 from rapid_tpu.engine.state import I32_MAX
 from rapid_tpu.oracle.cluster import default_rng
 from rapid_tpu.oracle.cut_detector import MultiNodeCutDetector
-from rapid_tpu.oracle.membership_view import MembershipView, id_fingerprint
+from rapid_tpu.oracle.membership_view import (MembershipView, id_fingerprint,
+                                              uid_of)
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import (AlertMessage, EdgeStatus, Endpoint,
                              JoinStatusCode, NodeId)
@@ -91,12 +98,30 @@ class ChurnSchedule(NamedTuple):
     only while the expectation holds, mirroring the oracle's config-id
     filter expiring stale alerts. A NamedTuple of arrays is a jax pytree,
     so the schedule threads through ``jit``/``lax.scan`` untouched.
+
+    The ``redraw_*`` fields script UUID-retry identifier redraws: at
+    ``redraw_tick[s]`` (the oracle's response hop after the collision)
+    dormant slot ``s`` swaps its identity to the ``redraw_hi/lo`` uid
+    limbs and the ``redraw_idfp_*`` identifier-fingerprint limbs, and
+    ``topology.rank_and_insert`` moves its ring position incrementally.
+    They are ``None`` (and the engine phase compiles out) when the
+    scenario has no collisions — the overwhelmingly common case. The
+    planner schedules at most one redraw per tick; multiple retries of
+    one slot collapse to a single redraw at the last retry carrying the
+    final identity, exact because a dormant slot's intermediate identity
+    is protocol-invisible (its gatekeeper row is only read at its join
+    alert delivery, its fingerprints only at the decide).
     """
 
     join_tick: np.ndarray    # int32 [C]
     join_epoch: np.ndarray   # int32 [C]
     leave_tick: np.ndarray   # int32 [C]
     leave_epoch: np.ndarray  # int32 [C]
+    redraw_tick: object = None      # int32 [C] or None (= no redraws)
+    redraw_hi: object = None        # uint32 [C] replacement uid limbs
+    redraw_lo: object = None
+    redraw_idfp_hi: object = None   # uint32 [C] replacement id-fp limbs
+    redraw_idfp_lo: object = None
 
 
 def empty_schedule(c: int) -> ChurnSchedule:
@@ -106,6 +131,53 @@ def empty_schedule(c: int) -> ChurnSchedule:
         leave_tick=np.full(c, I32_MAX, np.int32),
         leave_epoch=np.zeros(c, np.int32),
     )
+
+
+def apply_redraws(xp, state, schedule: ChurnSchedule, t):
+    """Jitted redraw phase: apply this tick's identifier redraw, if any.
+
+    At most one slot redraws per tick (the planner enforces it), so the
+    update is a ``lax.cond`` around: swap the selected slot's uid /
+    member-fingerprint / identifier-fingerprint limbs, move its ring
+    position via ``topology.rank_and_insert``, and re-scan the derived
+    topology plus ring-0 positions from the updated order — all O(C·K),
+    no sort. Call only when ``schedule.redraw_tick is not None``.
+    """
+    from jax import lax
+
+    from rapid_tpu import hashing
+    from rapid_tpu.engine import paxos as paxos_mod
+    from rapid_tpu.engine import topology as topology_mod
+    from rapid_tpu.oracle.membership_view import _SEED_MEMBER
+
+    redraw_now = (t == schedule.redraw_tick) & ~state.member
+
+    def apply(st):
+        sel = xp.argmax(redraw_now).astype(xp.int32)
+        new_hi = schedule.redraw_hi[sel]
+        new_lo = schedule.redraw_lo[sel]
+        uid_hi = st.uid_hi.at[sel].set(new_hi)
+        uid_lo = st.uid_lo.at[sel].set(new_lo)
+        mfp_hi, mfp_lo = hashing.hash64_limbs(
+            xp, new_hi, new_lo, seed=_SEED_MEMBER)
+        ring_order, ring_rank = topology_mod.rank_and_insert(
+            xp, sel, uid_hi, uid_lo, st.ring_order, st.ring_rank)
+        subj_idx, obs_idx, gk_idx, fd_active, fd_first = \
+            topology_mod.build_topology(xp, st.member, ring_order, ring_rank)
+        return st._replace(
+            uid_hi=uid_hi, uid_lo=uid_lo,
+            mfp_hi=st.mfp_hi.at[sel].set(mfp_hi),
+            mfp_lo=st.mfp_lo.at[sel].set(mfp_lo),
+            idfp_hi=st.idfp_hi.at[sel].set(schedule.redraw_idfp_hi[sel]),
+            idfp_lo=st.idfp_lo.at[sel].set(schedule.redraw_idfp_lo[sel]),
+            ring_order=ring_order, ring_rank=ring_rank,
+            subj_idx=subj_idx, obs_idx=obs_idx, gk_idx=gk_idx,
+            fd_active=fd_active, fd_first=fd_first,
+            px_pos=paxos_mod.ring0_positions(
+                xp, st.member, ring_order, ring_rank),
+        )
+
+    return lax.cond(redraw_now.any(), apply, lambda st: st, state)
 
 
 @dataclass
@@ -120,6 +192,11 @@ class ChurnPlan:
     events: List[Tuple[int, str, int, Tuple[int, ...]]]
     final_members: frozenset
     final_config_id: int
+    redraws: Dict[int, int] = None       # slot -> scheduled redraw tick
+
+    def __post_init__(self):
+        if self.redraws is None:
+            self.redraws = {}
 
 
 def plan_churn(
@@ -190,9 +267,10 @@ def plan_churn(
     js: Dict[int, dict] = {}
     for s, t0 in joins.items():
         rng = default_rng(settings, endpoints[s])
+        first_id = NodeId(rng.getrandbits(64), rng.getrandbits(64))
         js[s] = {
             "attempt": 1, "start": t0,
-            "node_id": NodeId(rng.getrandbits(64), rng.getrandbits(64)),
+            "node_id": first_id, "first_id": first_id, "redraw": None,
             "rng": rng, "p1_epoch": None, "enq": None, "done": False,
         }
 
@@ -367,6 +445,11 @@ def plan_churn(
                     st["node_id"] = NodeId(st["rng"].getrandbits(64),
                                            st["rng"].getrandbits(64))
                     st["start"] = t + 1  # retry PreJoin goes out with the reply
+                    # The oracle draws the fresh NodeId when the collision
+                    # response lands, one hop after this evaluation; the
+                    # engine applies the redraw at that tick. Repeat
+                    # collisions overwrite: one redraw, final identity.
+                    st["redraw"] = t + 1
                     continue
                 st["p1_epoch"] = epoch
                 st["enq"] = t + 2  # reply hop + JoinMessage hop
@@ -450,11 +533,46 @@ def plan_churn(
                         schedule.leave_tick[s] = t
                         schedule.leave_epoch[s] = epoch
 
+    # Boot fingerprints carry each joiner's *first* attempt; a scheduled
+    # redraw swaps in the final identity before anything reads it.
     id_fps = np.zeros(c, np.uint64)
     joiner_ids: Dict[int, NodeId] = {}
+    redraws: Dict[int, int] = {}
     for s, st in js.items():
-        id_fps[s] = np.uint64(id_fingerprint(st["node_id"]))
         joiner_ids[s] = st["node_id"]
+        if st["redraw"] is not None:
+            redraws[s] = st["redraw"]
+            id_fps[s] = np.uint64(id_fingerprint(st["first_id"]))
+        else:
+            id_fps[s] = np.uint64(id_fingerprint(st["node_id"]))
+    if redraws:
+        by_tick: Dict[int, int] = {}
+        for s, rt in redraws.items():
+            if rt in by_tick:
+                raise ChurnEnvelopeError(
+                    f"slots {by_tick[rt]} and {s} both redraw their "
+                    f"NodeId at tick {rt} — the engine applies one "
+                    "identifier redraw per tick")
+            by_tick[rt] = s
+        redraw_tick = np.full(c, I32_MAX, np.int32)
+        redraw_hi = np.zeros(c, np.uint32)
+        redraw_lo = np.zeros(c, np.uint32)
+        redraw_idfp_hi = np.zeros(c, np.uint32)
+        redraw_idfp_lo = np.zeros(c, np.uint32)
+        for s, rt in redraws.items():
+            redraw_tick[s] = rt
+            # The engine's ring key is the *endpoint* uid, which a NodeId
+            # redraw does not move — so the scripted replacement limbs
+            # equal the boot limbs and rank_and_insert lands the slot back
+            # on its own position. The fingerprint swap is the real work.
+            redraw_hi[s], redraw_lo[s] = hashing.to_limbs(
+                uid_of(endpoints[s]))
+            redraw_idfp_hi[s], redraw_idfp_lo[s] = hashing.to_limbs(
+                id_fingerprint(js[s]["node_id"]))
+        schedule = schedule._replace(
+            redraw_tick=redraw_tick, redraw_hi=redraw_hi,
+            redraw_lo=redraw_lo, redraw_idfp_hi=redraw_idfp_hi,
+            redraw_idfp_lo=redraw_idfp_lo)
 
     return ChurnPlan(
         schedule=schedule,
@@ -464,6 +582,7 @@ def plan_churn(
         events=events,
         final_members=frozenset(members),
         final_config_id=view.get_current_configuration_id(),
+        redraws=redraws,
     )
 
 

@@ -620,6 +620,7 @@ def run_churn_differential(
     crashes: Optional[Dict[int, int]] = None,
     settings: Optional[Settings] = None,
     seed_slot: int = 0,
+    node_ids: Optional[List[NodeId]] = None,
 ) -> ChurnDiffResult:
     """Replay a join/leave/crash scenario through planner, oracle, engine.
 
@@ -628,7 +629,10 @@ def run_churn_differential(
     ``Cluster.join(seed)``, ``leaves[s]`` the tick it calls
     ``leave_gracefully()``, ``crashes[s]`` its crash tick. The planner
     raises ``ChurnEnvelopeError`` for scenarios outside the bit-identical
-    envelope *before* either simulation runs.
+    envelope *before* either simulation runs. ``node_ids`` overrides the
+    initial members' NodeIds (default ``default_node_ids``) — tests use
+    it to force a joiner's first NodeId draw to collide and exercise the
+    UUID-retry redraw path on both sides.
     """
     from rapid_tpu.engine.churn import plan_churn
     from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
@@ -640,7 +644,10 @@ def run_churn_differential(
     crashes = dict(crashes or {})
     settings = settings or Settings()
     endpoints = default_endpoints(capacity)
-    node_ids = default_node_ids(n)
+    if node_ids is None:
+        node_ids = default_node_ids(n)
+    elif len(node_ids) != n:
+        raise ValueError(f"node_ids must cover the {n} initial members")
 
     # --- plan: host protocol mirror, raises if out of envelope ----------
     plan = plan_churn(endpoints, n, node_ids, n_ticks, settings,

@@ -131,20 +131,20 @@ def classic_rank_index(xp, uid_hi, uid_lo):
     return (lo & xp.uint32(0x7FFFFFFF)).astype(xp.int32)
 
 
-def ring0_positions(xp, uid_hi, uid_lo, member):
+def ring0_positions(xp, member, ring_order, ring_rank):
     """i32 [C]: each member's position in ring-0 order (the broadcaster's
     recipient order, hence the phase-1b arrival order at the coordinator);
     non-members read ``I32_MAX``.
 
-    Same sort key as ring 0 of ``topology.build_topology`` — the
-    ``hash64(uid, seed=0)`` with the uid as tiebreak."""
-    khi, klo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=0)
-    order = xp.lexsort((uid_lo, uid_hi, klo, khi)).astype(xp.int32)
-    member_s = member.astype(bool)[order]
+    Sort-free: gathers the member mask through the static ring-0 order
+    (``EngineState.ring_order[:, 0]`` — the same ``hash64(uid, seed=0)``
+    key with the uid as tiebreak that ``topology.ring_permutations``
+    sorted once at boot) and prefix-sums member positions."""
+    member_b = member.astype(bool)
+    member_s = member_b[ring_order[:, 0]]
     mrank_s = xp.cumsum(member_s.astype(xp.int32)) - 1
-    rank = xp.argsort(order).astype(xp.int32)  # rank[slot] = sorted position
-    mpos = mrank_s[rank]
-    return xp.where(member, mpos, xp.int32(I32_MAX))
+    mpos = mrank_s[ring_rank[:, 0]]
+    return xp.where(member_b, mpos, xp.int32(I32_MAX))
 
 
 def rank_lt(ar, ai, br, bi):
@@ -383,9 +383,14 @@ def task_phase(xp, state, sched: FallbackSchedule, t, n, decided_now):
 
 
 def np_ring0_positions(uids: np.ndarray, member: np.ndarray) -> np.ndarray:
-    """Host mirror of ``ring0_positions`` over uint64 uids."""
+    """Host mirror of ``ring0_positions`` over uint64 uids (host-side, so
+    it runs its own boot lexsort via ``topology.ring_permutations``)."""
+    from rapid_tpu.engine.topology import ring_permutations
+
     hi, lo = hashing.np_to_limbs(np.asarray(uids, np.uint64))
-    return np.asarray(ring0_positions(np, hi, lo, np.asarray(member, bool)))
+    order, rank = ring_permutations(np, hi, lo, 1)
+    return np.asarray(ring0_positions(np, np.asarray(member, bool),
+                                      order, rank))
 
 
 def host_coordinator_rule(n: int, positions: Dict[int, int],

@@ -12,10 +12,13 @@ membership are *dormant*: present in every array, excluded by the
 - identity: 64-bit node uids as ``(hi, lo)`` uint32 limb pairs (TPUs have no
   native 64-bit ints; see ``rapid_tpu.hashing``), plus per-slot membership
   and identifier fingerprints for the running configuration-id sums;
-- topology: ``subj_idx[n, k]`` / ``obs_idx[n, k]`` — node ``n``'s ring-``k``
-  subject (predecessor) and observer (successor) slot, plus ``gk_idx`` —
-  a dormant slot's join gatekeepers — recomputed from the shared hash
-  order on every view change;
+- topology: the static per-ring hash order ``ring_order``/``ring_rank``
+  (lexsorted once at boot by ``topology.ring_permutations``; moved only
+  by UUID-retry identifier redraws via ``topology.rank_and_insert``),
+  and the derived ``subj_idx[n, k]`` / ``obs_idx[n, k]`` — node ``n``'s
+  ring-``k`` subject (predecessor) and observer (successor) slot, plus
+  ``gk_idx`` — a dormant slot's join gatekeepers — re-scanned sort-free
+  from that order on every view change;
 - monitoring: per unique-subject tombstone counters ``fc`` and the
   notified-once latch, mirroring ``PingPongFailureDetector``;
 - alert pipeline: the oracle's enqueue -> flush(+1 tick) -> deliver(+1 tick)
@@ -147,7 +150,11 @@ class EngineState(NamedTuple):
     idsum_lo: object                  # u32 scalar
     memsum_hi: object                 # u32 scalar: member-fp sum
     memsum_lo: object                 # u32 scalar
-    # topology (recomputed on view change)
+    # static per-ring hash order (boot-time lexsort; only identifier
+    # redraws move it, via topology.rank_and_insert)
+    ring_order: object                # i32 [C, K] slot at each ring position
+    ring_rank: object                 # i32 [C, K] ring position of each slot
+    # topology (re-scanned from ring_order/ring_rank on view change)
     subj_idx: object                  # i32 [C, K]
     obs_idx: object                   # i32 [C, K]
     gk_idx: object                    # i32 [C, K] join gatekeepers (dormant rows)
@@ -306,7 +313,7 @@ def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
     import jax.numpy as jnp
 
     from rapid_tpu.engine.paxos import ring0_positions
-    from rapid_tpu.engine.topology import build_topology
+    from rapid_tpu.engine.topology import build_topology, ring_permutations
     from rapid_tpu.oracle.membership_view import _SEED_MEMBER
 
     uids_np = np.asarray(uids, dtype=np.uint64)
@@ -332,11 +339,17 @@ def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
     idh, idl = hashing.to_limbs(id_fp_sum)
     msh, msl = hashing.to_limbs(memsum)
 
+    # The once-per-universe lexsort: host numpy, before anything touches
+    # the device. Every later view change re-scans this static order.
+    ring_order_np, ring_rank_np = ring_permutations(np, uid_hi, uid_lo, k)
+
     member_arr = jnp.asarray(member_np)
     uid_hi = jnp.asarray(uid_hi)
     uid_lo = jnp.asarray(uid_lo)
+    ring_order = jnp.asarray(ring_order_np)
+    ring_rank = jnp.asarray(ring_rank_np)
     subj_idx, obs_idx, gk_idx, fd_active, fd_first = build_topology(
-        jnp, uid_hi, uid_lo, member_arr, k)
+        jnp, member_arr, ring_order, ring_rank)
     zero_ck_i = jnp.zeros((c, k), jnp.int32)
     zero_ck_b = jnp.zeros((c, k), bool)
     u32 = lambda v: jnp.uint32(v)
@@ -348,6 +361,7 @@ def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
         idfp_hi=jnp.asarray(ifp_hi), idfp_lo=jnp.asarray(ifp_lo),
         idsum_hi=u32(idh), idsum_lo=u32(idl),
         memsum_hi=u32(msh), memsum_lo=u32(msl),
+        ring_order=ring_order, ring_rank=ring_rank,
         subj_idx=subj_idx, obs_idx=obs_idx, gk_idx=gk_idx,
         fd_active=fd_active, fd_first=fd_first,
         fc=zero_ck_i, notified=zero_ck_b,
@@ -373,7 +387,7 @@ def init_state(uids: Sequence[int], id_fp_sum: int, settings: Settings,
         px_crnd_i=jnp.zeros((c,), jnp.int32),
         px_cval=jnp.full((c,), -1, jnp.int32),
         px_timer=jnp.full((c,), I32_MAX, jnp.int32),
-        px_pos=ring0_positions(jnp, uid_hi, uid_lo, member_arr),
+        px_pos=ring0_positions(jnp, member_arr, ring_order, ring_rank),
         c1a_tick=jnp.int32(I32_MAX), c1a_coord=jnp.int32(0),
         c1a_rank_r=jnp.int32(0), c1a_rank_i=jnp.int32(0),
         c1a_epoch=jnp.int32(-1),

@@ -9,7 +9,8 @@ delivery phase) sort before alert batches (sent during its run_due phase):
    quorum triggers the view change (membership XOR with the proposal:
    leavers/crashed limb-subtract their member fingerprints from the
    membership sum, joiners limb-add theirs and fold their identifier
-   fingerprint into the identifier sum; topology rebuild, full
+   fingerprint into the identifier sum; a sort-free topology re-scan of
+   the static ``ring_order``/``ring_rank`` arrays, full
    monitor/cut/consensus reset, FD re-alignment via ``fd_gate``, and an
    ``epoch`` increment that expires any in-flight churn alerts — the
    oracle's config-id filter);
@@ -53,6 +54,7 @@ from jax import lax
 
 from rapid_tpu import hashing
 from rapid_tpu.engine import cut, invariants, monitor
+from rapid_tpu.engine import churn as churn_mod
 from rapid_tpu.engine import paxos as paxos_mod
 from rapid_tpu.engine import votes as votes_mod
 from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
@@ -149,10 +151,9 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         ihi, ilo = hashing.sum64(jnp, state.idfp_hi * jn, state.idfp_lo * jn)
         id_hi, id_lo = hashing.add64(
             jnp, state.idsum_hi, state.idsum_lo, ihi, ilo)
-        topo = build_topology(jnp, state.uid_hi, state.uid_lo, member,
-                              settings.K)
-        pos = (paxos_mod.ring0_positions(jnp, state.uid_hi, state.uid_lo,
-                                         member)
+        topo = build_topology(jnp, member, state.ring_order, state.ring_rank)
+        pos = (paxos_mod.ring0_positions(jnp, member, state.ring_order,
+                                         state.ring_rank)
                if fallback is not None else state.px_pos)
         return (member, ms_hi, ms_lo, id_hi, id_lo, pos) + topo
 
@@ -263,6 +264,14 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
                        pending_flush=jnp.zeros_like(mid.pending_flush),
                        churn_deliver=mid.churn_flush,
                        churn_flush=jnp.zeros_like(mid.churn_flush))
+
+    # ---- phase 4a': scripted identifier redraws (UUID-retry hop) -------
+    # A joiner whose NodeId collided redraws at the oracle's response
+    # hop: swap the dormant slot's identity limbs and move its ring
+    # position incrementally (topology.rank_and_insert) — no sort.
+    # Schedules without redraws carry None and compile this out.
+    if churn is not None and churn.redraw_tick is not None:
+        mid = churn_mod.apply_redraws(jnp, mid, churn, t)
 
     # ---- phase 4a: churn alert injection (scheduled enqueue ticks) -----
     if churn is not None:

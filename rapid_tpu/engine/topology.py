@@ -6,18 +6,32 @@ uid as tiebreak — the *same* sort key the oracle's ``MembershipView`` uses
 collision), computed with the *same* ``hash64_limbs`` — so ring order agrees
 by construction (SURVEY.md §7 "hash parity").
 
-One lexsort per ring orders the *full slot universe* — members and dormant
-slots interleaved. Members are then linked by nearest-member prefix scans
-(cummax/cummin over member positions), which yields two things from the
-same sort:
+The ring order is an *invariant of the slot universe*: node identities are
+fixed at boot and churn only flips the ``member`` mask, so the per-ring
+lexsort runs exactly once — ``ring_permutations`` sorts each ring at boot
+into static ``ring_order[C, K]`` / ``ring_rank[C, K]`` arrays carried in
+``EngineState``. A view change is then sort-free: gather the ``member``
+mask into ring order, cumsum it into member ranks, and compact the
+members so every ring-neighbour query is rank arithmetic plus a gather —
+one O(C) scan per ring instead of an O(C log C) sort, with no
+``lexsort``/``argsort`` traced in the jitted step. The scan yields two
+things from the same static order:
 
 - member ring neighbours: predecessor = subject, successor = observer;
 - joiner gatekeepers: a dormant slot's nearest member *predecessor* is
   exactly the oracle's ``get_expected_observers_of`` — the predecessor of
   the joiner's would-be ring position (MembershipView.java:292-303).
 
+The one event that can move a slot's ring position — a UUID-retry
+identifier redraw during a join handshake — is applied incrementally by
+``rank_and_insert``: recompute the redrawn slot's rank per ring with an
+O(C) smaller-key count and shift the order arrays by one position, no
+global re-sort. The churn planner schedules redraws through the
+``ChurnSchedule`` (``rapid_tpu.engine.churn``) so they stay inside the
+jitted path.
+
 Everything is shape-static and jit-compatible: membership changes only
-flip the ``member`` mask and re-run the sort.
+flip the ``member`` mask and re-run the scans over the static order.
 """
 from __future__ import annotations
 
@@ -26,24 +40,190 @@ import numpy as np
 from rapid_tpu import hashing
 
 
-def _cummax(xp, x):
+#: Block width of the member scans in ``_pred_succ_pos`` — pinned to 8:
+#: each block is one ``packbits`` byte, so every block-local scan is a
+#: pure table lookup on that byte.
+_SCAN_BLOCK = 8
+
+
+def _scan_luts():
+    """Per-byte nearest-set-bit tables, [256, 8] int8 plus [256] int8.
+
+    For mask ``m`` and bit ``j``: ``pred[m, j]`` is the last set bit
+    strictly below ``j`` when bit ``j`` is set (a member's predecessor
+    query) and at-or-below ``j`` otherwise (a non-member's gatekeeper
+    query), -1 if none; ``succ[m, j]`` is the first set bit strictly
+    above ``j``, 8 if none; ``last``/``first`` are the block carries.
+    """
+    bits = ((np.arange(256)[:, None] >> np.arange(8)[None, :]) & 1) \
+        .astype(bool)
+    pred = np.full((256, 8), -1, np.int8)
+    succ = np.full((256, 8), 8, np.int8)
+    last = np.full(256, -1, np.int8)
+    first = np.full(256, 8, np.int8)
+    for m in range(256):
+        for j in range(8):
+            below = np.flatnonzero(bits[m, :j + (0 if bits[m, j] else 1)])
+            if below.size:
+                pred[m, j] = below[-1]
+            above = np.flatnonzero(bits[m, j + 1:])
+            if above.size:
+                succ[m, j] = above[0] + j + 1
+        set_ = np.flatnonzero(bits[m])
+        if set_.size:
+            last[m] = set_[-1]
+            first[m] = set_[0]
+    return pred, succ, last, first
+
+
+_LUT_PRED, _LUT_SUCC, _LUT_LAST, _LUT_FIRST = _scan_luts()
+
+
+def _pred_succ_pos(xp, member_s, n):
+    """Nearest-member ring positions from the mask in ring order.
+
+    Returns ``(pgpos, succpos)``, i32 ``[C]``: for ring position ``p``,
+    ``pgpos[p]`` is the position of the last member strictly before
+    ``p`` (members) or at-or-before ``p`` (non-members — the gatekeeper
+    query), and ``succpos[p]`` the first member strictly after ``p``;
+    both wrap around the ring, and an empty view falls back to position
+    0 (bit-identical to the legacy sort path's empty-ring scan).
+
+    The device path blocks the scans ``[C/B, B]`` with *block-local*
+    int8 offsets, so the O(C·log C) log-depth associative scan shrinks
+    to one short reduce-window per block plus a B-times-smaller i32
+    carry scan — this and the two gathers that consume the positions
+    are what bounds ``build_topology``'s FLOPs/bytes, no scatter and no
+    prefix-sum compaction anywhere in the tick path (XLA's CPU scatter
+    alone cost more wall clock than the whole kernel does now).
+    """
+    c = member_s.shape[0]
     if xp is np:
-        return np.maximum.accumulate(x)
+        pos = np.arange(c, dtype=np.int32)
+        prev_incl = np.maximum.accumulate(
+            np.where(member_s, pos, np.int32(-1)))
+        prev_excl = np.concatenate([np.full(1, -1, np.int32),
+                                    prev_incl[:-1]])
+        pgpos = np.where(member_s, prev_excl, prev_incl)
+        lastf = np.where(n > 0, prev_incl[-1], np.int32(0))
+        pgpos = np.where(pgpos < 0, lastf, pgpos).astype(np.int32)
+        next_incl = np.minimum.accumulate(
+            np.where(member_s, pos, np.int32(c))[::-1])[::-1]
+        next_excl = np.concatenate([next_incl[1:], np.full(1, c, np.int32)])
+        firstf = np.where(n > 0, next_incl[0], np.int32(0))
+        succpos = np.where(next_excl >= c, firstf,
+                           next_excl).astype(np.int32)
+        return pgpos, succpos
+
     from jax import lax
 
-    return lax.cummax(x, axis=0)
+    b = _SCAN_BLOCK
+    # packbits zero-pads the last byte, and zero bits are non-members,
+    # so no explicit padding is needed anywhere.
+    packed = xp.packbits(member_s, bitorder="little")  # uint8 [ceil(C/8)]
+    nb = packed.shape[0]
+    base = xp.arange(nb, dtype=xp.int32) * b
+    end = xp.int32(nb * b)  # past-the-end sentinel for the carries
+
+    # Forward: the block-local "last member at-or-before (strictly
+    # before for members)" scan is a pure per-byte table lookup — a
+    # gather, zero FLOPs — and only the i32 carry scan over per-block
+    # last-member positions runs at full precision, on C/8 elements.
+    # The ring wrap is resolved on the carries too, never on the full
+    # vector.
+    loc = xp.asarray(_LUT_PRED)[packed]          # int8 [nb, 8]
+    blast = xp.asarray(_LUT_LAST)[packed]        # int8 [nb]
+    bpos = xp.where(blast >= 0, base + blast, xp.int32(-1))
+    bprev = lax.cummax(bpos)
+    lastf = xp.where(n > 0, bprev[-1], xp.int32(0))
+    bprev_excl = xp.concatenate([xp.full(1, -1, xp.int32), bprev[:-1]])
+    bprev_excl = xp.where(bprev_excl < 0, lastf, bprev_excl)
+    pgpos = xp.where(loc >= 0, base[:, None] + loc.astype(xp.int32),
+                     bprev_excl[:, None]).reshape(-1)[:c]
+
+    # Backward mirror: first member strictly after, local sentinel B,
+    # block carries wrapped to the first member (padding bits are
+    # non-members, so they never perturb a real position's answer, and
+    # a local hit is always a real member — wrap only ever comes from
+    # the carries).
+    sloc = xp.asarray(_LUT_SUCC)[packed]         # int8 [nb, 8]
+    bfirst = xp.asarray(_LUT_FIRST)[packed]      # int8 [nb]
+    bposf = xp.where(bfirst < b, base + bfirst, end)
+    bnext = lax.cummin(bposf, reverse=True)
+    firstf = xp.where(n > 0, bnext[0], xp.int32(0))
+    bnext_excl = xp.concatenate([bnext[1:], end[None]])
+    bnext_excl = xp.where(bnext_excl >= c, firstf, bnext_excl)
+    succpos = xp.where(sloc < b, base[:, None] + sloc.astype(xp.int32),
+                       bnext_excl[:, None]).reshape(-1)[:c]
+    return pgpos, succpos
 
 
-def _cummin_rev(xp, x):
+def _inverse_permutation(xp, perm, pos):
+    """rank[slot] = position, given order[position] = slot — an O(C)
+    scatter, not an argsort."""
     if xp is np:
-        return np.minimum.accumulate(x[::-1])[::-1]
-    from jax import lax
+        rank = np.empty_like(perm)
+        rank[perm] = pos
+        return rank
+    return xp.zeros_like(perm).at[perm].set(pos)
 
-    return lax.cummin(x, axis=0, reverse=True)
+
+def ring_permutations(xp, uid_hi, uid_lo, k: int):
+    """The static per-ring sort: (ring_order, ring_rank), each i32 [C, K].
+
+    ``ring_order[p, j]`` is the slot at sorted position ``p`` of ring
+    ``j``; ``ring_rank[s, j]`` is slot ``s``'s position (they are inverse
+    permutations per ring). This is the *only* place the per-ring lexsort
+    runs — boot time (``engine.state.init_state``), never inside the
+    jitted step. Key order matches the oracle: ``hash64(uid, seed=ring)``
+    primary, uid tiebreak.
+    """
+    pos = xp.arange(uid_hi.shape[0], dtype=xp.int32)
+    orders, ranks = [], []
+    for ring in range(k):
+        khi, klo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=ring)
+        # last key is primary: (key_hi, key_lo, uid_hi, uid_lo)
+        order = xp.lexsort((uid_lo, uid_hi, klo, khi)).astype(xp.int32)
+        orders.append(order)
+        ranks.append(_inverse_permutation(xp, order, pos))
+    return xp.stack(orders, axis=1), xp.stack(ranks, axis=1)
 
 
-def build_topology(xp, uid_hi, uid_lo, member, k: int):
-    """Compute (subj_idx, obs_idx, gk_idx, fd_active, fd_first), each ``[C, K]``.
+def rank_and_insert(xp, slot, uid_hi, uid_lo, ring_order, ring_rank):
+    """Re-rank one slot after an identifier redraw — sort-free.
+
+    ``uid_hi``/``uid_lo`` must already carry ``slot``'s *new* limbs. Per
+    ring: recompute the slot's rank as the count of lexicographically
+    smaller keys (O(C) compares, keys are unique because uids are), then
+    shift every rank between the old and new position by one and scatter
+    the inverse back into the order array. ``slot`` may be a traced i32
+    scalar. Returns the updated (ring_order, ring_rank).
+    """
+    c, k = ring_order.shape
+    pos = xp.arange(c, dtype=xp.int32)
+    new_orders, new_ranks = [], []
+    for ring in range(k):
+        khi, klo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=ring)
+        skhi, sklo = khi[slot], klo[slot]
+        suhi, sulo = uid_hi[slot], uid_lo[slot]
+        less = (khi < skhi) | ((khi == skhi) & (
+            (klo < sklo) | ((klo == sklo) & (
+                (uid_hi < suhi) | ((uid_hi == suhi) & (uid_lo < sulo))))))
+        r_new = less.sum().astype(xp.int32)
+        rank = ring_rank[:, ring]
+        r_old = rank[slot]
+        shift_down = ((rank > r_old) & (rank <= r_new)).astype(xp.int32)
+        shift_up = ((rank >= r_new) & (rank < r_old)).astype(xp.int32)
+        rank = rank - shift_down + shift_up
+        rank = xp.where(pos == slot, r_new, rank)
+        new_ranks.append(rank)
+        new_orders.append(_inverse_permutation(xp, rank, pos))
+    return xp.stack(new_orders, axis=1), xp.stack(new_ranks, axis=1)
+
+
+def build_topology(xp, member, ring_order, ring_rank):
+    """Compute (subj_idx, obs_idx, gk_idx, fd_active, fd_first), each ``[C, K]``,
+    from the static per-ring order — no sort traced.
 
     - ``subj_idx[n, j]``: slot of node n's ring-j subject (predecessor);
     - ``obs_idx[n, j]``: slot of node n's ring-j observer (successor);
@@ -61,53 +241,53 @@ def build_topology(xp, uid_hi, uid_lo, member, k: int):
     Non-member rows of ``subj_idx``/``obs_idx`` point at themselves and are
     fully masked.
     """
-    c = uid_hi.shape[0]
+    c, k = ring_order.shape
     member = member.astype(bool)
     n = member.sum().astype(xp.int32)
     slots = xp.arange(c, dtype=xp.int32)
-    pos = xp.arange(c, dtype=xp.int32)
 
     subj_cols = []
     obs_cols = []
     gk_cols = []
     for ring in range(k):
-        khi, klo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=ring)
-        # last key is primary: (key_hi, key_lo, uid_hi, uid_lo)
-        order = xp.lexsort((uid_lo, uid_hi, klo, khi)).astype(xp.int32)
+        order = ring_order[:, ring]
+        rank = ring_rank[:, ring]
         member_s = member[order]
 
-        # Nearest member strictly before each sorted position (wrap to the
-        # last member overall); -1 only when there are no members at all.
-        midx = xp.where(member_s, pos, xp.int32(-1))
-        incl = _cummax(xp, midx)
-        prev = xp.concatenate([xp.full((1,), -1, xp.int32), incl[:-1]])
-        prev = xp.where(prev < 0, incl[-1], prev)
-        prev = xp.maximum(prev, 0)  # safe gather when memberless
-
-        # Nearest member strictly after each sorted position (wrap to the
-        # first member overall); sentinel c when there are none.
-        nidx = xp.where(member_s, pos, xp.int32(c))
-        incl_n = _cummin_rev(xp, nidx)
-        nxt = xp.concatenate([incl_n[1:], xp.full((1,), c, xp.int32)])
-        first_m = xp.minimum(incl_n[0], c - 1)
-        nxt = xp.where(nxt >= c, first_m, nxt)
-
-        rank = xp.argsort(order).astype(xp.int32)  # rank[slot] = ring position
-        pred = order[prev][rank]
-        succ = order[nxt][rank]
-        subj_cols.append(xp.where(member, pred, slots))
+        # The legacy nearest-member scan pair, but over the static boot
+        # order: pgpos doubles as the member's subject position and the
+        # non-member's gatekeeper position. Positions become slots by
+        # gathering through each slot's own ring position and then
+        # through the order — one shared [2, C] gather pair for both
+        # neighbour columns.
+        pgpos, succpos = _pred_succ_pos(xp, member_s, n)
+        if xp is np:
+            pg = order[pgpos[rank]]
+            succ = order[succpos[rank]]
+        else:
+            vals = order[xp.stack([pgpos, succpos])[:, rank]]
+            pg, succ = vals[0], vals[1]
+        subj_cols.append(xp.where(member, pg, slots))
         obs_cols.append(xp.where(member, succ, slots))
-        gk_cols.append(xp.where(member, slots, pred))
+        gk_cols.append(xp.where(member, slots, pg))
     subj_idx = xp.stack(subj_cols, axis=1)
     obs_idx = xp.stack(obs_cols, axis=1)
     gk_idx = xp.stack(gk_cols, axis=1)
 
     # Dedup per unique subject: slot j is active iff no earlier ring slot
-    # has the same subject. eq[n, j, i] = subj[n, j] == subj[n, i].
-    eq = subj_idx[:, :, None] == subj_idx[:, None, :]
-    earlier = xp.tril(xp.ones((k, k), bool), k=-1)[None, :, :]
+    # has the same subject, and fd_first[:, j] is the first such slot — a
+    # first-occurrence scan over the (static, small) K ring slots. Largest
+    # temporary is [C], replacing the [C, K, K] pairwise-eq tensor that
+    # used to dominate this kernel's temp_bytes.
     usable = member & (n >= 2)  # a <=1-member view has no subjects
-    fd_active = ~(eq & earlier).any(axis=2) & usable[:, None]
-    # First ring slot with the same subject (argmax finds the first True).
-    fd_first = xp.argmax(eq, axis=2).astype(xp.int32)
+    fd_first_cols = []
+    fd_active_cols = []
+    for j in range(k):
+        first = xp.full((c,), j, xp.int32)
+        for i in range(j - 1, -1, -1):
+            first = xp.where(subj_cols[i] == subj_cols[j], xp.int32(i), first)
+        fd_first_cols.append(first)
+        fd_active_cols.append((first == j) & usable)
+    fd_first = xp.stack(fd_first_cols, axis=1)
+    fd_active = xp.stack(fd_active_cols, axis=1)
     return subj_idx, obs_idx, gk_idx, fd_active, fd_first

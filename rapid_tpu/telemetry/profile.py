@@ -125,10 +125,8 @@ def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
     from rapid_tpu.engine.step import step as step_fn
     from rapid_tpu.engine.topology import build_topology
 
-    k = settings.K
-
-    def topology_rebuild(uid_hi, uid_lo, member):
-        return build_topology(jnp, uid_hi, uid_lo, member, k)
+    def topology_rebuild(member, ring_order, ring_rank):
+        return build_topology(jnp, member, ring_order, ring_rank)
 
     def monitor_kernel(state, faults):
         return monitor.monitor_tick(jnp, state, faults, settings)
@@ -155,7 +153,7 @@ def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
 
     cases = [
         ("topology_rebuild", topology_rebuild,
-         (state.uid_hi, state.uid_lo, state.member)),
+         (state.member, state.ring_order, state.ring_rank)),
         ("monitor", monitor_kernel, (state, faults)),
         ("cut_aggregate", cut_aggregate, (state, faults)),
         ("vote_count", vote_count, (state, faults)),

@@ -11,6 +11,13 @@ any drift is a protocol change that either updates the baseline
 deliberately or is a bug. Wall-clock throughput is machine-dependent, so
 ``ticks_per_sec`` regressions only warn (default tolerance 30%).
 
+``kernel_profile_sweep`` payloads (``--profile-sweep``) are also
+accepted: runs are matched by ``n`` against the committed
+``benchmarks/dominance_report.json`` (picked automatically when
+``--baseline`` is left at its default) and per-kernel wall-clock medians
+diff warn-only — wall time is machine-dependent, so only a K mismatch or
+a kernel disappearing from the sweep is an error.
+
 Usage (wired into ``scripts/tier1.sh``)::
 
     python bench.py --n 256 --ticks 120 --out /tmp/bench.json
@@ -99,14 +106,69 @@ def compare_run(current: Dict, baseline: Dict, where: str,
     return errors, warnings
 
 
+def compare_profile_sweeps(current: Dict, baseline: Dict,
+                           wall_tolerance: float
+                           ) -> Tuple[List[str], List[str]]:
+    """Diff two ``kernel_profile_sweep`` payloads.
+
+    Runs match by ``n`` (the smoke sweeps a subset of the committed
+    sizes, so extra baseline sizes are fine; a current size absent from
+    the baseline is skipped with a warning). Per-kernel wall medians are
+    machine-dependent and only warn past ``wall_tolerance``; a K
+    mismatch or a kernel row missing from the current sweep is an error.
+    """
+    errors: List[str] = []
+    warnings: List[str] = []
+    base_runs = {run.get("n"): run for run in baseline.get("runs", [])}
+    for run in current.get("runs", []):
+        n = run.get("n")
+        where = f"payload.runs[n={n}]"
+        base = base_runs.get(n)
+        if base is None:
+            warnings.append(f"{where}: no baseline run at this n "
+                            f"(baseline sizes {sorted(base_runs)})")
+            continue
+        if run.get("k") != base.get("k"):
+            errors.append(f"{where}.k: config mismatch (current "
+                          f"{run.get('k')!r} vs baseline {base.get('k')!r})"
+                          f" — regenerate with --update-baseline")
+            continue
+        base_kernels = {k["kernel"]: k for k in base.get("kernels", [])}
+        cur_kernels = {k["kernel"]: k for k in run.get("kernels", [])}
+        for name in sorted(set(base_kernels) - set(cur_kernels)):
+            errors.append(f"{where}: kernel {name!r} in baseline but "
+                          f"missing from current sweep")
+        for name, cur_k in sorted(cur_kernels.items()):
+            base_k = base_kernels.get(name)
+            if base_k is None:
+                warnings.append(f"{where}: new kernel {name!r} not in "
+                                f"baseline")
+                continue
+            cur_w = cur_k.get("wall_median_s")
+            base_w = base_k.get("wall_median_s")
+            if isinstance(cur_w, (int, float)) and \
+                    isinstance(base_w, (int, float)) and base_w > 0 and \
+                    cur_w > base_w * (1.0 + wall_tolerance):
+                up = 100.0 * (cur_w / base_w - 1.0)
+                warnings.append(
+                    f"{where}.{name}.wall_median_s: {cur_w:.3e} is "
+                    f"{up:.0f}% above baseline {base_w:.3e} (tolerance "
+                    f"{wall_tolerance * 100:.0f}%)")
+    return errors, warnings
+
+
 def compare_payloads(current: Dict, baseline: Dict,
-                     tps_tolerance: float) -> Tuple[List[str], List[str]]:
-    """Diff two schema-valid payloads (suite or single run)."""
+                     tps_tolerance: float,
+                     wall_tolerance: float = 0.50
+                     ) -> Tuple[List[str], List[str]]:
+    """Diff two schema-valid payloads (suite, single run, or sweep)."""
     cur_kind = current.get("bench")
     base_kind = baseline.get("bench")
     if cur_kind != base_kind:
         return ([f"payload.bench: kind mismatch (current {cur_kind!r} vs "
                  f"baseline {base_kind!r})"], [])
+    if cur_kind == "kernel_profile_sweep":
+        return compare_profile_sweeps(current, baseline, wall_tolerance)
     if cur_kind == "engine_tick_suite":
         errors: List[str] = []
         warnings: List[str] = []
@@ -123,14 +185,18 @@ def compare_payloads(current: Dict, baseline: Dict,
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("current", help="bench payload JSON to check")
-    parser.add_argument("--baseline",
-                        default=os.path.join(_REPO, "benchmarks",
-                                             "baseline.json"),
-                        help="committed baseline payload "
-                             "(default benchmarks/baseline.json)")
+    parser.add_argument("--baseline", default=None,
+                        help="committed baseline payload (default "
+                             "benchmarks/baseline.json, or benchmarks/"
+                             "dominance_report.json for kernel_profile_"
+                             "sweep payloads)")
     parser.add_argument("--tps-tolerance", type=float, default=0.30,
                         help="warn when ticks_per_sec drops more than "
                              "this fraction below baseline (default 0.30)")
+    parser.add_argument("--wall-tolerance", type=float, default=0.50,
+                        help="warn when a profiled kernel's wall median "
+                             "rises more than this fraction above the "
+                             "baseline sweep (default 0.50)")
     parser.add_argument("--update-baseline", action="store_true",
                         help="overwrite the baseline with the current "
                              "payload (schema-validated) and exit 0")
@@ -138,6 +204,11 @@ def main(argv=None) -> int:
 
     with open(args.current) as fh:
         current = json.load(fh)
+    if args.baseline is None:
+        name = ("dominance_report.json"
+                if current.get("bench") == "kernel_profile_sweep"
+                else "baseline.json")
+        args.baseline = os.path.join(_REPO, "benchmarks", name)
     schema_errors = validate_bench_payload(current)
     if schema_errors:
         for e in schema_errors:
@@ -165,7 +236,8 @@ def main(argv=None) -> int:
         return 1
 
     errors, warnings = compare_payloads(current, baseline,
-                                        args.tps_tolerance)
+                                        args.tps_tolerance,
+                                        args.wall_tolerance)
     for w in warnings:
         print(f"bench_compare: WARNING: {w}", file=sys.stderr)
     if errors:

@@ -70,12 +70,15 @@ fi
 # Kernel-profile smoke: the per-kernel cost observatory must lower every
 # sub-kernel and emit a schema-valid dominance report (small N, few
 # repeats — the full 1k/10k/100k sweep is run manually; see
-# benchmarks/dominance_report.json).
+# benchmarks/dominance_report.json). bench_compare.py then diffs the
+# N=256 per-kernel wall medians against the committed sweep — warn-only
+# (wall time is machine-dependent); only a K/kernel-set mismatch fails.
 if [ "$rc" -eq 0 ]; then
     if timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/bench_engine.py \
             --profile-sweep --profile-sizes 256 --profile-repeats 2 \
             --out /tmp/_t1_profile.json >/dev/null \
-        && python -m rapid_tpu.telemetry.schema /tmp/_t1_profile.json; then
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_profile.json \
+        && python scripts/bench_compare.py /tmp/_t1_profile.json; then
         echo PROFILE_SMOKE=ok
     else
         echo PROFILE_SMOKE=failed

@@ -17,6 +17,7 @@ from rapid_tpu.engine import (
     trace_count,
 )
 from rapid_tpu.engine.state import I32_MAX, crash_faults
+from rapid_tpu.engine.topology import ring_permutations
 from rapid_tpu.oracle.membership_view import MembershipView, uid_of
 from rapid_tpu.settings import Settings
 from rapid_tpu.types import Endpoint, NodeId
@@ -50,9 +51,10 @@ def test_topology_matches_oracle(n):
     endpoints, _, view = make_members(n)
     uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
     uid_hi, uid_lo = hashing.np_to_limbs(uids)
+    order, rank = ring_permutations(np, uid_hi, uid_lo, SETTINGS.K)
     member = jnp.ones((n,), bool)
     subj_idx, obs_idx, _, fd_active, _ = build_topology(
-        jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
+        jnp, member, jnp.asarray(order), jnp.asarray(rank))
     subj_idx = np.asarray(subj_idx)
     obs_idx = np.asarray(obs_idx)
     fd_active = np.asarray(fd_active)
@@ -78,9 +80,10 @@ def test_topology_nonmember_rows_masked():
     endpoints, _, _ = make_members(8)
     uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
     uid_hi, uid_lo = hashing.np_to_limbs(uids)
+    order, rank = ring_permutations(np, uid_hi, uid_lo, SETTINGS.K)
     member = jnp.asarray([True] * 6 + [False] * 2)
     subj_idx, obs_idx, gk_idx, fd_active, _ = build_topology(
-        jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
+        jnp, member, jnp.asarray(order), jnp.asarray(rank))
     assert np.all(np.asarray(subj_idx)[6:] == np.arange(6, 8)[:, None])
     assert np.all(np.asarray(obs_idx)[6:] == np.arange(6, 8)[:, None])
     assert not np.asarray(fd_active)[6:].any()
@@ -99,9 +102,10 @@ def test_topology_gatekeepers_match_oracle(n, extra):
                           endpoints[:n])
     uids = np.asarray([uid_of(e) for e in endpoints], dtype=np.uint64)
     uid_hi, uid_lo = hashing.np_to_limbs(uids)
+    order, rank = ring_permutations(np, uid_hi, uid_lo, SETTINGS.K)
     member = jnp.asarray([True] * n + [False] * extra)
     _, _, gk_idx, _, _ = build_topology(
-        jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), member, SETTINGS.K)
+        jnp, member, jnp.asarray(order), jnp.asarray(rank))
     gk_idx = np.asarray(gk_idx)
 
     slot_of = {e: i for i, e in enumerate(endpoints)}

@@ -1,0 +1,408 @@
+"""Static-order topology: sort-free equivalence and identifier redraws.
+
+The engine hoists the per-ring lexsort out of the tick loop
+(``topology.ring_permutations`` at boot, sort-free ``build_topology`` /
+``ring0_positions`` per view change). These tests pin:
+
+- bit-identical output of the sort-free path against the *old* lexsort
+  implementation, kept below as a NumPy reference, across seeds, K, and
+  membership masks;
+- ``rank_and_insert`` (the UUID-redraw incremental update) against a
+  from-scratch re-sort, including slots that actually move;
+- the jitted redraw phase end to end (scheduled uid swap inside
+  ``lax.scan``) and the oracle-triangulated UUID-collision scenario via
+  ``run_churn_differential``;
+- the acceptance criterion itself: no sort primitive traced in the
+  jitted topology / ring-0 kernels, nor anywhere in the jitted step
+  beyond the vote-counting segmented bincount.
+"""
+import numpy as np
+import pytest
+
+from rapid_tpu import hashing
+from rapid_tpu.engine.paxos import ring0_positions
+from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+from rapid_tpu.engine.topology import (build_topology, rank_and_insert,
+                                       ring_permutations)
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import NodeId
+
+SETTINGS = Settings()
+
+
+# ---------------------------------------------------------------------------
+# the pre-hoist implementation, kept verbatim as the NumPy reference
+# ---------------------------------------------------------------------------
+
+
+def legacy_build_topology(uid_hi, uid_lo, member, k):
+    """The old per-view-change lexsort ``build_topology`` (NumPy only)."""
+    c = uid_hi.shape[0]
+    member = member.astype(bool)
+    n = member.sum().astype(np.int32)
+    slots = np.arange(c, dtype=np.int32)
+    pos = np.arange(c, dtype=np.int32)
+
+    subj_cols, obs_cols, gk_cols = [], [], []
+    for ring in range(k):
+        khi, klo = hashing.hash64_limbs(np, uid_hi, uid_lo, seed=ring)
+        order = np.lexsort((uid_lo, uid_hi, klo, khi)).astype(np.int32)
+        member_s = member[order]
+        midx = np.where(member_s, pos, np.int32(-1))
+        incl = np.maximum.accumulate(midx)
+        prev = np.concatenate([np.full((1,), -1, np.int32), incl[:-1]])
+        prev = np.where(prev < 0, incl[-1], prev)
+        prev = np.maximum(prev, 0)
+        nidx = np.where(member_s, pos, np.int32(c))
+        incl_n = np.minimum.accumulate(nidx[::-1])[::-1]
+        nxt = np.concatenate([incl_n[1:], np.full((1,), c, np.int32)])
+        first_m = np.minimum(incl_n[0], c - 1)
+        nxt = np.where(nxt >= c, first_m, nxt)
+        rank = np.argsort(order).astype(np.int32)
+        pred = order[prev][rank]
+        succ = order[nxt][rank]
+        subj_cols.append(np.where(member, pred, slots))
+        obs_cols.append(np.where(member, succ, slots))
+        gk_cols.append(np.where(member, slots, pred))
+    subj_idx = np.stack(subj_cols, axis=1)
+    obs_idx = np.stack(obs_cols, axis=1)
+    gk_idx = np.stack(gk_cols, axis=1)
+
+    eq = subj_idx[:, :, None] == subj_idx[:, None, :]
+    earlier = np.tril(np.ones((k, k), bool), k=-1)[None, :, :]
+    usable = member & (n >= 2)
+    fd_active = ~(eq & earlier).any(axis=2) & usable[:, None]
+    fd_first = np.argmax(eq, axis=2).astype(np.int32)
+    return subj_idx, obs_idx, gk_idx, fd_active, fd_first
+
+
+def legacy_ring0_positions(uid_hi, uid_lo, member):
+    """The old per-view-change lexsort ``ring0_positions`` (NumPy only)."""
+    khi, klo = hashing.hash64_limbs(np, uid_hi, uid_lo, seed=0)
+    order = np.lexsort((uid_lo, uid_hi, klo, khi)).astype(np.int32)
+    member_s = member.astype(bool)[order]
+    mrank_s = np.cumsum(member_s.astype(np.int32)) - 1
+    rank = np.argsort(order).astype(np.int32)
+    mpos = mrank_s[rank]
+    return np.where(member, mpos, np.int32(I32_MAX))
+
+
+def synthetic_limbs(c, seed):
+    hi, lo = hashing.np_to_limbs(np.arange(1, c + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xABC0 ^ seed)
+    uids = hashing.np_from_limbs(hi, lo)
+    assert len(np.unique(uids)) == c, "synthetic uids must be distinct"
+    return hi, lo
+
+
+def membership_masks(c, rng):
+    yield np.ones(c, bool)
+    yield np.zeros(c, bool)
+    single = np.zeros(c, bool)
+    single[int(rng.integers(c))] = True
+    yield single
+    for p in (0.2, 0.5, 0.9):
+        yield rng.random(c) < p
+
+
+# ---------------------------------------------------------------------------
+# sort-free equivalence property sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7])
+@pytest.mark.parametrize("k", [1, 3, 10])
+def test_sortfree_build_topology_matches_legacy(seed, k):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(100 * seed + k)
+    c = int(rng.integers(3, 70))
+    uid_hi, uid_lo = synthetic_limbs(c, seed)
+    order, rank = ring_permutations(np, uid_hi, uid_lo, k)
+    order_j, rank_j = jnp.asarray(order), jnp.asarray(rank)
+
+    for member in membership_masks(c, rng):
+        legacy = legacy_build_topology(uid_hi, uid_lo, member, k)
+        host = build_topology(np, member, order, rank)
+        device = build_topology(jnp, jnp.asarray(member), order_j, rank_j)
+        for name, a, b, d in zip(
+                ("subj_idx", "obs_idx", "gk_idx", "fd_active", "fd_first"),
+                legacy, host, device):
+            np.testing.assert_array_equal(
+                np.asarray(b), np.asarray(a),
+                err_msg=f"{name} host diverged (seed={seed} k={k})")
+            np.testing.assert_array_equal(
+                np.asarray(d), np.asarray(a),
+                err_msg=f"{name} device diverged (seed={seed} k={k})")
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_sortfree_ring0_positions_matches_legacy(seed):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    c = int(rng.integers(3, 70))
+    uid_hi, uid_lo = synthetic_limbs(c, seed)
+    order, rank = ring_permutations(np, uid_hi, uid_lo, 1)
+    for member in membership_masks(c, rng):
+        legacy = legacy_ring0_positions(uid_hi, uid_lo, member)
+        host = ring0_positions(np, member, order, rank)
+        device = ring0_positions(jnp, jnp.asarray(member),
+                                 jnp.asarray(order), jnp.asarray(rank))
+        np.testing.assert_array_equal(np.asarray(host), legacy)
+        np.testing.assert_array_equal(np.asarray(device), legacy)
+
+
+def test_ring_permutations_are_inverse_and_device_identical():
+    import jax.numpy as jnp
+
+    uid_hi, uid_lo = synthetic_limbs(57, 3)
+    order, rank = ring_permutations(np, uid_hi, uid_lo, SETTINGS.K)
+    pos = np.arange(57, dtype=np.int32)
+    for ring in range(SETTINGS.K):
+        np.testing.assert_array_equal(rank[order[:, ring], ring], pos)
+    order_j, rank_j = ring_permutations(
+        jnp, jnp.asarray(uid_hi), jnp.asarray(uid_lo), SETTINGS.K)
+    np.testing.assert_array_equal(np.asarray(order_j), order)
+    np.testing.assert_array_equal(np.asarray(rank_j), rank)
+
+
+# ---------------------------------------------------------------------------
+# rank-and-insert: incremental redraw vs from-scratch re-sort
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_rank_and_insert_matches_resort(seed):
+    k = 5
+    c = 41
+    uid_hi, uid_lo = synthetic_limbs(c, seed)
+    uid_hi, uid_lo = uid_hi.copy(), uid_lo.copy()
+    order, rank = ring_permutations(np, uid_hi, uid_lo, k)
+    rng = np.random.default_rng(seed)
+    for _ in range(6):
+        slot = int(rng.integers(c))
+        uid_hi[slot] = np.uint32(rng.integers(1 << 32))
+        uid_lo[slot] = np.uint32(rng.integers(1 << 32))
+        order, rank = rank_and_insert(np, slot, uid_hi, uid_lo, order, rank)
+        oref, rref = ring_permutations(np, uid_hi, uid_lo, k)
+        np.testing.assert_array_equal(order, oref)
+        np.testing.assert_array_equal(rank, rref)
+
+
+def test_rank_and_insert_traced_slot_matches_host():
+    import jax
+    import jax.numpy as jnp
+
+    k = 4
+    c = 23
+    uid_hi, uid_lo = synthetic_limbs(c, 9)
+    uid_hi, uid_lo = uid_hi.copy(), uid_lo.copy()
+    order, rank = ring_permutations(np, uid_hi, uid_lo, k)
+    slot = 11
+    uid_hi[slot], uid_lo[slot] = np.uint32(0xDEAD), np.uint32(0xBEEF)
+
+    jitted = jax.jit(lambda s, h, lo, o, r: rank_and_insert(jnp, s, h, lo,
+                                                            o, r))
+    order_j, rank_j = jitted(jnp.int32(slot), jnp.asarray(uid_hi),
+                             jnp.asarray(uid_lo), jnp.asarray(order),
+                             jnp.asarray(rank))
+    oref, rref = ring_permutations(np, uid_hi, uid_lo, k)
+    np.testing.assert_array_equal(np.asarray(order_j), oref)
+    np.testing.assert_array_equal(np.asarray(rank_j), rref)
+
+
+def test_scheduled_redraw_moves_ring_position_in_scan():
+    """End to end through the jitted scan: a scheduled redraw swaps a
+    dormant slot's identity and its ring arrays match a from-scratch
+    re-sort of the new universe."""
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine.churn import empty_schedule
+    from rapid_tpu.engine.step import simulate
+
+    n, c = 12, 13
+    slot = 12
+    hi, lo = synthetic_limbs(c, 4)
+    uids = hashing.np_from_limbs(hi, lo)
+    member = [True] * n + [False]
+    state = init_state(uids, 0, SETTINGS, member=member)
+
+    new_uid = np.uint64(hashing.hash64(0x5EED, seed=7))
+    new_hi, new_lo = hashing.to_limbs(int(new_uid))
+    sched = empty_schedule(c)
+    redraw_tick = np.full(c, I32_MAX, np.int32)
+    redraw_tick[slot] = 3
+    zeros = np.zeros(c, np.uint32)
+    sched = sched._replace(
+        redraw_tick=redraw_tick,
+        redraw_hi=zeros.copy(), redraw_lo=zeros.copy(),
+        redraw_idfp_hi=zeros.copy(), redraw_idfp_lo=zeros.copy())
+    sched.redraw_hi[slot] = new_hi
+    sched.redraw_lo[slot] = new_lo
+    sched.redraw_idfp_hi[slot] = 0x1234
+    sched.redraw_idfp_lo[slot] = 0x5678
+
+    faults = crash_faults([I32_MAX] * c)
+    final, _ = simulate(state, faults, 6, SETTINGS, churn=sched)
+
+    uids_after = uids.copy()
+    uids_after[slot] = new_uid
+    hi2, lo2 = hashing.np_to_limbs(uids_after)
+    oref, rref = ring_permutations(np, hi2, lo2, SETTINGS.K)
+    np.testing.assert_array_equal(np.asarray(final.ring_order), oref)
+    np.testing.assert_array_equal(np.asarray(final.ring_rank), rref)
+    # derived topology re-scanned from the moved order
+    topo = build_topology(np, np.asarray(member), oref, rref)
+    for got, want in zip((final.subj_idx, final.obs_idx, final.gk_idx,
+                          final.fd_active, final.fd_first), topo):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # identity limbs and fingerprints swapped in place
+    assert int(final.uid_hi[slot]) == new_hi
+    assert int(final.uid_lo[slot]) == new_lo
+    assert int(final.idfp_hi[slot]) == 0x1234
+    assert int(final.idfp_lo[slot]) == 0x5678
+    mh, ml = hashing.hash64_limbs(
+        np, np.uint32(new_hi), np.uint32(new_lo), seed=0x6D656D62)
+    assert int(final.mfp_hi[slot]) == int(mh)
+    assert int(final.mfp_lo[slot]) == int(ml)
+    # px_pos re-scanned: members keep positions, dormant slot stays masked
+    np.testing.assert_array_equal(
+        np.asarray(final.px_pos),
+        np.asarray(ring0_positions(np, np.asarray(member), oref, rref)))
+
+
+# ---------------------------------------------------------------------------
+# UUID-collision redraw, triangulated planner / oracle / engine
+# ---------------------------------------------------------------------------
+
+
+def test_uuid_redraw_triangulates_against_oracle():
+    from rapid_tpu.engine.churn import plan_churn
+    from rapid_tpu.engine.diff import (default_endpoints, default_node_ids,
+                                       run_churn_differential)
+    from rapid_tpu.oracle.cluster import default_rng
+
+    n, capacity, joiner = 64, 65, 64
+    endpoints = default_endpoints(capacity)
+    # Burn the joiner's first NodeId draw into an initial member, so the
+    # phase-1 evaluation answers UUID_ALREADY_IN_RING on both sides and
+    # the retry redraws through the engine's rank-and-insert path.
+    rng = default_rng(SETTINGS, endpoints[joiner])
+    collide = NodeId(rng.getrandbits(64), rng.getrandbits(64))
+    node_ids = list(default_node_ids(n))
+    node_ids[3] = collide
+
+    plan = plan_churn(endpoints, n, node_ids, 40, SETTINGS,
+                      joins={joiner: 5})
+    # join() at 5 -> PreJoin 6 collides -> redraw lands with the reply at 7
+    assert plan.redraws == {joiner: 7}
+    assert plan.schedule.redraw_tick is not None
+    assert plan.schedule.redraw_tick[joiner] == 7
+
+    res = run_churn_differential(n=n, capacity=capacity, n_ticks=40,
+                                 joins={joiner: 5}, node_ids=node_ids)
+    res.assert_identical()
+    # retry start 7 -> PreJoin 8 -> enqueue 10 -> flush 11 -> announce 12
+    # -> decide 13
+    assert [(e.kind, e.tick, e.slots) for e in res.engine_events] == [
+        ("proposal", 12, (joiner,)), ("view_change", 13, (joiner,))]
+    assert res.engine_members == frozenset(range(capacity))
+
+
+def test_uuid_redraw_without_collision_schedules_nothing():
+    from rapid_tpu.engine.churn import plan_churn
+    from rapid_tpu.engine.diff import default_endpoints, default_node_ids
+
+    endpoints = default_endpoints(65)
+    plan = plan_churn(endpoints, 64, default_node_ids(64), 40, SETTINGS,
+                      joins={64: 5})
+    assert plan.redraws == {}
+    assert plan.schedule.redraw_tick is None  # phase compiles out
+
+
+# ---------------------------------------------------------------------------
+# jaxpr inspection: the acceptance criterion itself
+# ---------------------------------------------------------------------------
+
+
+def _count_sorts(jaxpr) -> int:
+    """Count sort primitives in a jaxpr, recursing into sub-jaxprs
+    (cond branches, scan bodies, closed calls)."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "sort":
+            total += 1
+        for v in eqn.params.values():
+            for x in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(x, "jaxpr", x)
+                if hasattr(inner, "eqns"):
+                    total += _count_sorts(inner)
+    return total
+
+
+def test_no_sort_traced_in_topology_or_ring0_kernels():
+    import jax
+    import jax.numpy as jnp
+
+    c = 32
+    uid_hi, uid_lo = synthetic_limbs(c, 2)
+    order, rank = ring_permutations(np, uid_hi, uid_lo, SETTINGS.K)
+    member = jnp.ones(c, bool)
+    order_j, rank_j = jnp.asarray(order), jnp.asarray(rank)
+
+    topo = jax.make_jaxpr(
+        lambda m, o, r: build_topology(jnp, m, o, r))(member, order_j,
+                                                      rank_j)
+    assert _count_sorts(topo.jaxpr) == 0
+
+    r0 = jax.make_jaxpr(
+        lambda m, o, r: ring0_positions(jnp, m, o, r))(member, order_j,
+                                                       rank_j)
+    assert _count_sorts(r0.jaxpr) == 0
+
+    rai = jax.make_jaxpr(
+        lambda s, h, lo, o, r: rank_and_insert(jnp, s, h, lo, o, r))(
+        jnp.int32(3), jnp.asarray(uid_hi), jnp.asarray(uid_lo), order_j,
+        rank_j)
+    assert _count_sorts(rai.jaxpr) == 0
+
+    # sanity: the boot-time permutation builder is where the sort lives
+    perms = jax.make_jaxpr(
+        lambda h, lo: ring_permutations(jnp, h, lo, SETTINGS.K))(
+        jnp.asarray(uid_hi), jnp.asarray(uid_lo))
+    assert _count_sorts(perms.jaxpr) == SETTINGS.K
+
+
+def test_step_sorts_only_for_vote_counting():
+    """The full jitted step — churn phase with redraws included — traces
+    exactly the vote-count segmented bincount's sorts and nothing else;
+    every topology/ring-0 sort is gone from the tick loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine.churn import empty_schedule
+    from rapid_tpu.engine.step import step
+    from rapid_tpu.engine.votes import segmented_vote_count
+
+    c = 16
+    hi, lo = synthetic_limbs(c, 1)
+    uids = hashing.np_from_limbs(hi, lo)
+    state = init_state(uids, 0, SETTINGS)
+    faults = crash_faults([I32_MAX] * c)
+    sched = empty_schedule(c)
+    sched = sched._replace(
+        redraw_tick=np.full(c, I32_MAX, np.int32),
+        redraw_hi=np.zeros(c, np.uint32), redraw_lo=np.zeros(c, np.uint32),
+        redraw_idfp_hi=np.zeros(c, np.uint32),
+        redraw_idfp_lo=np.zeros(c, np.uint32))
+
+    stepx = jax.make_jaxpr(
+        lambda st, f, ch: step(st, f, SETTINGS, ch, None))(state, faults,
+                                                           sched)
+    votes_only = jax.make_jaxpr(
+        lambda h, lo, v: segmented_vote_count(jnp, h, lo, v))(
+        jnp.zeros(c, jnp.uint32), jnp.zeros(c, jnp.uint32),
+        jnp.zeros(c, bool))
+    assert _count_sorts(votes_only.jaxpr) > 0  # the one legitimate sort
+    assert _count_sorts(stepx.jaxpr) == _count_sorts(votes_only.jaxpr)

@@ -1,15 +1,17 @@
 #!/usr/bin/env python
 """Repo-root benchmark shim: steady + churn + contested + partition
-suite, JSON out.
++ fleet suite, JSON out.
 
 This is the harness entry point (``python bench.py``): it runs the
-engine tick benchmark four times — an N=1k steady crash-burst, an N=1k
+engine tick benchmark five times — an N=1k steady crash-burst, an N=1k
 sustained-churn run, an N=1k contested-consensus run through the
-classic-Paxos fallback kernel, and a small one-way-partition run
+classic-Paxos fallback kernel, a small one-way-partition run
 through the fault adversary (a host-side oracle differential, so it
-uses its own ``--partition-n`` size) — with defaults small enough to
-finish quickly on CPU, and emits a single ``engine_tick_suite`` JSON
-payload.
+uses its own ``--partition-n`` size), and a deterministic Monte-Carlo
+``fleet`` campaign (``--fleet-clusters`` N=``--fleet-n`` clusters with
+a mixed fault/churn sample, vmapped into one dispatch; see
+``rapid_tpu/campaign.py``) — with defaults small enough to finish
+quickly on CPU, and emits a single ``engine_tick_suite`` JSON payload.
 
 The stdout payload is always one compact *summary-only* line (the last
 line, explicitly flushed, so harnesses that parse the stdout tail always
@@ -43,6 +45,7 @@ from benchmarks.bench_engine import (  # noqa: E402
     run,
     run_churn,
     run_contested,
+    run_fleet,
     run_partition,
 )
 
@@ -57,7 +60,7 @@ def _compact_payload(payload: dict) -> dict:
     artifact keeps the full rows.
     """
     out = dict(payload)
-    for key in ("steady", "churn", "contested", "partition"):
+    for key in ("steady", "churn", "contested", "partition", "fleet"):
         run_p = dict(out[key])
         tel = dict(run_p["telemetry"])
         tel["view_changes_elided"] = len(tel.get("view_changes") or [])
@@ -85,6 +88,15 @@ def main(argv=None) -> int:
                         help="ticks for the partition run (needs to "
                              "cover FD saturation plus the classic "
                              "fallback round; default 300)")
+    parser.add_argument("--fleet-clusters", type=int, default=64,
+                        help="clusters in the fleet campaign entry "
+                             "(one vmapped dispatch; default 64)")
+    parser.add_argument("--fleet-n", type=int, default=64,
+                        help="members per fleet cluster (default 64)")
+    parser.add_argument("--fleet-ticks", type=int, default=240,
+                        help="ticks per fleet cluster (covers FD "
+                             "saturation, partitions healing at half "
+                             "run, and churn cycles; default 240)")
     parser.add_argument("--out", type=str, default=None,
                         help="write the JSON artifact to FILE "
                              "(default: stdout)")
@@ -106,6 +118,8 @@ def main(argv=None) -> int:
         "contested": run_contested(args.n, args.ticks, settings, args.seed),
         "partition": run_partition(args.partition_n, args.partition_ticks,
                                    settings, args.seed),
+        "fleet": run_fleet(args.fleet_clusters, args.fleet_n,
+                           args.fleet_ticks, settings, args.seed),
     }
     if args.out:
         with open(args.out, "w") as fh:

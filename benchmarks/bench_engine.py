@@ -334,6 +334,23 @@ def run_partition(n: int, ticks: int, settings, seed: int = 0,
     }
 
 
+def run_fleet(clusters: int, n: int, ticks: int, settings, seed: int = 0,
+              fleet_size: int = None, spot_checks: int = 0) -> dict:
+    """Monte-Carlo fleet campaign: ``clusters`` sampled fault/churn
+    scenarios vmapped over a leading fleet axis, ``fleet_size`` clusters
+    per jitted dispatch (``rapid_tpu.campaign``). The payload is an
+    ``engine_tick`` run whose ``telemetry`` is the fleet-merged
+    RunSummary plus the ``campaign`` distributions block; with
+    ``spot_checks > 0`` a seeded member subset is replayed through the
+    host oracle referee and the run dies on any per-slot divergence."""
+    from rapid_tpu.campaign import CampaignConfig, run_campaign
+
+    cfg = CampaignConfig(clusters=clusters, n=n, ticks=ticks, seed=seed,
+                         fleet_size=fleet_size or clusters,
+                         spot_checks=spot_checks, settings=settings)
+    return run_campaign(cfg)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--n", type=int, default=10_000,
@@ -347,14 +364,24 @@ def main(argv=None) -> int:
                         help="tick of the correlated crash burst")
     parser.add_argument("--scenario",
                         choices=("steady", "churn", "contested",
-                                 "partition"),
+                                 "partition", "fleet"),
                         default="steady",
                         help="steady crash-burst, sustained join/leave "
                              "churn, contested consensus through the "
-                             "classic-Paxos fallback, or a one-way "
+                             "classic-Paxos fallback, a one-way "
                              "partition through the fault adversary "
                              "(host-side differential; keep --n small "
-                             "and --ticks >= 250) (default steady)")
+                             "and --ticks >= 250), or a vmapped "
+                             "Monte-Carlo fleet campaign over sampled "
+                             "scenarios (default steady)")
+    parser.add_argument("--clusters", type=int, default=64,
+                        help="fleet scenario: sampled clusters")
+    parser.add_argument("--fleet-size", type=int, default=None,
+                        help="fleet scenario: clusters per dispatch "
+                             "(default: all in one dispatch)")
+    parser.add_argument("--spot-checks", type=int, default=0,
+                        help="fleet scenario: members replayed through "
+                             "the host oracle referee")
     parser.add_argument("--burst", type=int, default=8,
                         help="churn scenario: slots per join/leave burst")
     parser.add_argument("--seed", type=int, default=0,
@@ -421,6 +448,14 @@ def main(argv=None) -> int:
                 parser.error("--trace records jitted runs; the partition "
                              "scenario is a host-side differential")
             results = [run_partition(n, args.ticks, settings, args.seed)
+                       for n in sizes]
+        elif args.scenario == "fleet":
+            if writer is not None:
+                parser.error("--trace records one cluster's logs; use "
+                             "python -m rapid_tpu.campaign for fleets")
+            results = [run_fleet(args.clusters, n, args.ticks, settings,
+                                 args.seed, fleet_size=args.fleet_size,
+                                 spot_checks=args.spot_checks)
                        for n in sizes]
         else:
             results = [run(n, args.ticks, args.crash_frac, args.crash_tick,

@@ -1,0 +1,293 @@
+"""Monte-Carlo fault campaigns over a fleet of batched clusters.
+
+The campaign driver samples scenario space with
+``faults.sample_adversary_schedule`` (seeded weights over
+crash/partition/flip-flop/contested/churn mixes), lowers every draw to a
+device ``FleetMember`` (``engine.fleet.lower_schedule``), and runs
+``fleet_size`` clusters per jitted dispatch — thousands of independent
+clusters complete in one process with a single compile, since every
+dispatch shares the batched program shape.
+
+Aggregation goes through the existing telemetry layer: each member's
+logs fold into a ``RunSummary`` (``telemetry.metrics.fleet_summaries``),
+the fleet aggregate merges with the documented max-vs-total gauge
+semantics (``merge_summaries`` / ``schema.GAUGE_SEMANTICS``), and
+campaign distributions (ticks-to-decide percentiles, message-complexity
+tails, invariant-violation rates) are nearest-rank percentiles over the
+per-member summaries — bit-deterministic in the campaign seed.
+
+Exactness: a seeded subset of members (≥1 partition and ≥1 contested /
+classic-fallback scenario when the check budget allows) is replayed
+host-side through ``diff.run_adversarial_differential``, the per-slot
+oracle referee. Churn-mix members are excluded from the spot-check pool
+— the referee replays ``AdversarySchedule`` surfaces only; churn
+scheduling stays engine-side (see ``engine.churn``). This referee loop
+is the only host-side part of a campaign.
+
+CLI::
+
+    python -m rapid_tpu.campaign --clusters 1024 --n 64 --ticks 240 \
+        --seed 0 --fleet-size 64 --spot-checks 8 --out campaign.json
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import random
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from rapid_tpu import hashing
+from rapid_tpu.faults import (DEFAULT_SCENARIO_WEIGHTS, SampledScenario,
+                              ScenarioWeights, sample_adversary_schedule)
+from rapid_tpu.settings import Settings
+
+__all__ = ["CampaignConfig", "run_campaign", "main"]
+
+#: Spot-check kinds the acceptance gate requires when the budget allows:
+#: a partition (link-masked FD path) and a contested split (classic-Paxos
+#: fallback on both sides of the differential).
+REQUIRED_SPOT_KINDS = ("partition", "contested")
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignConfig:
+    """Knobs of one campaign; everything downstream is derived from these
+    (same config => bit-identical aggregates and distributions)."""
+
+    clusters: int = 64
+    n: int = 64
+    ticks: int = 240
+    seed: int = 0
+    fleet_size: int = 64
+    headroom: int = 16          # dormant slots per cluster for churn joins
+    weights: Optional[ScenarioWeights] = None
+    spot_checks: int = 0
+    settings: Optional[Settings] = None
+
+
+def _member_seed(cfg: CampaignConfig, idx: int) -> int:
+    """Deterministic per-member scenario seed from the campaign seed."""
+    return hashing.hash64(idx, seed=cfg.seed & hashing.MASK64) & 0x7FFFFFFF
+
+
+def _sample_member(cfg: CampaignConfig, settings: Settings, idx: int):
+    """Draw member ``idx``'s scenario and lower it to the device."""
+    from rapid_tpu.engine import churn as churn_mod
+    from rapid_tpu.engine.fleet import lower_schedule
+
+    seed = _member_seed(cfg, idx)
+    sc = sample_adversary_schedule(cfg.n, seed, cfg.ticks,
+                                   cfg.weights or DEFAULT_SCENARIO_WEIGHTS)
+    churn = id_fps = None
+    if sc.wants_churn and cfg.headroom >= 2:
+        rng = random.Random(seed ^ 0xC4B0)
+        burst = min(cfg.headroom, rng.choice((2, 4, 8)))
+        churn, id_fps, _ = churn_mod.synthetic_churn_schedule(
+            cfg.n + cfg.headroom, cfg.n, settings,
+            start=rng.randint(5, 25), burst=burst)
+    member = lower_schedule(sc.schedule, settings, churn=churn,
+                            id_fps=id_fps)
+    return member, sc
+
+
+def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
+                referee_settings: Settings) -> Dict[str, object]:
+    """Replay a seeded member subset through the host oracle referee.
+
+    ``run_adversarial_differential`` raises (with forensics) on any
+    per-slot divergence, so a campaign either reports every check passed
+    or dies loudly. Members whose scenario wants churn are ineligible
+    (the referee replays fault surfaces only); if a required kind is
+    missing from the eligible pool, a fresh forced scenario of that kind
+    is synthesized from the campaign seed and checked as member ``-1``.
+    """
+    from rapid_tpu.engine.diff import run_adversarial_differential
+
+    requested = cfg.spot_checks
+    block: Dict[str, object] = {"requested": requested, "run": 0,
+                                "passed": 0, "members": []}
+    if requested <= 0:
+        return block
+    rng = random.Random(cfg.seed ^ 0x5EED)
+    eligible = [i for i, sc in enumerate(scenarios) if not sc.wants_churn]
+    chosen: List[Tuple[int, SampledScenario]] = []
+    used = set()
+    for kind in REQUIRED_SPOT_KINDS[:requested]:
+        pool = [i for i in eligible
+                if scenarios[i].kind == kind and i not in used]
+        if pool:
+            i = rng.choice(pool)
+            used.add(i)
+            chosen.append((i, scenarios[i]))
+        else:  # tiny campaign without this kind: force one
+            forced_seed = hashing.hash64(
+                len(chosen), seed=(cfg.seed ^ 0xF0CE) & hashing.MASK64
+            ) & 0x7FFFFFFF
+            weights = ScenarioWeights(
+                **{k: (1.0 if k == kind else 0.0)
+                   for k in ("crash", "partition", "flip_flop",
+                             "contested", "churn")})
+            forced = sample_adversary_schedule(cfg.n, forced_seed,
+                                               cfg.ticks, weights)
+            chosen.append((-1, forced))
+    rest = [i for i in eligible if i not in used]
+    rng.shuffle(rest)
+    for i in rest[:max(0, requested - len(chosen))]:
+        chosen.append((i, scenarios[i]))
+
+    for idx, sc in chosen:
+        result = run_adversarial_differential(sc.schedule, cfg.ticks,
+                                              referee_settings)
+        result.assert_identical()
+        block["run"] += 1
+        block["passed"] += 1
+        block["members"].append({"member": idx, "kind": sc.kind,
+                                 "seed": sc.schedule.seed})
+    return block
+
+
+def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
+    """Run one campaign; returns a schema-v3 bench run payload.
+
+    The payload validates as an ``engine_tick`` run (``telemetry`` is the
+    fleet-merged ``RunSummary``) and additionally carries the
+    ``campaign`` block: scenario-kind counts, spot-check results, and
+    nearest-rank distributions over per-member summaries.
+    ``ticks_per_sec`` is aggregate cluster-ticks per second across all
+    dispatches (compile included — campaigns are one-shot programs).
+    """
+    import jax
+
+    from rapid_tpu.engine.fleet import fleet_simulate, stack_members
+    from rapid_tpu.telemetry.metrics import (fleet_summaries,
+                                             merge_summaries,
+                                             summary_distributions)
+    from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+    base = cfg.settings or Settings()
+    c = cfg.n + cfg.headroom
+    settings = base if base.capacity == c else base.with_(capacity=c)
+    referee_settings = base if base.capacity == 0 else base.with_(capacity=0)
+    f = max(1, cfg.fleet_size)
+    dispatches = -(-cfg.clusters // f)
+    total = dispatches * f
+
+    t0 = time.perf_counter()
+    sampled = [_sample_member(cfg, settings, i) for i in range(total)]
+    scenarios = [sc for _, sc in sampled]
+    boot_s = time.perf_counter() - t0
+
+    summaries = []
+    t0 = time.perf_counter()
+    fold_s = 0.0
+    for d in range(dispatches):
+        fleet = stack_members([m for m, _ in
+                               sampled[d * f:(d + 1) * f]])
+        finals, logs = fleet_simulate(fleet, cfg.ticks, settings)
+        jax.block_until_ready(finals)
+        tf = time.perf_counter()
+        summaries += fleet_summaries(logs)
+        fold_s += time.perf_counter() - tf
+    wall_s = time.perf_counter() - t0 - fold_s
+
+    merged = merge_summaries(summaries)
+    dists = summary_distributions(summaries)
+    kinds: Dict[str, int] = {}
+    for sc in scenarios:
+        kinds[sc.kind] = kinds.get(sc.kind, 0) + 1
+
+    t0 = time.perf_counter()
+    spot = _spot_check(cfg, scenarios, referee_settings)
+    spot_s = time.perf_counter() - t0
+
+    return {
+        "bench": "engine_tick",
+        "scenario": "fleet",
+        "schema_version": SCHEMA_VERSION,
+        "platform": jax.default_backend(),
+        "n": cfg.n,
+        "k": settings.K,
+        "capacity": c,
+        "ticks": cfg.ticks,
+        "clusters": total,
+        "fleet_size": f,
+        "dispatches": dispatches,
+        "boot_s": boot_s,
+        "wall_s": wall_s,
+        "fold_s": fold_s,
+        "spot_check_s": spot_s,
+        "ticks_per_sec": total * cfg.ticks / wall_s if wall_s else 0.0,
+        "rounds_per_sec": merged.decisions / wall_s if wall_s else 0.0,
+        "announcements": merged.announcements,
+        "decisions": merged.decisions,
+        "telemetry": merged.as_dict(),
+        "campaign": {
+            "seed": cfg.seed,
+            "clusters": total,
+            "fleet_size": f,
+            "dispatches": dispatches,
+            "scenario_kinds": dict(sorted(kinds.items())),
+            "spot_checks": spot,
+            "distributions": dists,
+        },
+    }
+
+
+def _parse_weights(text: str) -> ScenarioWeights:
+    """``crash=1,partition=2,...`` -> ScenarioWeights (missing keys keep
+    their defaults)."""
+    kw = {}
+    for part in text.split(","):
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        kw[key.strip()] = float(val)
+    return ScenarioWeights(**kw)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Monte-Carlo fleet campaign over sampled fault "
+                    "scenarios (see rapid_tpu/campaign.py docstring)")
+    parser.add_argument("--clusters", type=int, default=64,
+                        help="sampled clusters (rounded up to a whole "
+                             "number of dispatches)")
+    parser.add_argument("--n", type=int, default=64,
+                        help="initial members per cluster")
+    parser.add_argument("--ticks", type=int, default=240)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--fleet-size", type=int, default=64,
+                        help="clusters per jitted dispatch (F)")
+    parser.add_argument("--headroom", type=int, default=16,
+                        help="dormant slots per cluster for churn joins")
+    parser.add_argument("--spot-checks", type=int, default=0,
+                        help="members replayed through the host oracle "
+                             "referee (run_adversarial_differential)")
+    parser.add_argument("--weights", type=_parse_weights, default=None,
+                        metavar="K=W,...",
+                        help="scenario mix, e.g. crash=1,partition=2,"
+                             "flip_flop=0,contested=1,churn=1")
+    parser.add_argument("--out", type=str, default=None,
+                        help="write the full payload JSON here")
+    args = parser.parse_args(argv)
+
+    cfg = CampaignConfig(clusters=args.clusters, n=args.n, ticks=args.ticks,
+                         seed=args.seed, fleet_size=args.fleet_size,
+                         headroom=args.headroom, weights=args.weights,
+                         spot_checks=args.spot_checks)
+    payload = run_campaign(cfg)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+    # Last stdout line is the machine-readable payload (the bench.py
+    # contract); campaigns have no per-view-change rows to elide.
+    print(json.dumps(payload), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

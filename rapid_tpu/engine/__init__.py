@@ -39,6 +39,15 @@ from rapid_tpu.engine.step import (
     step,
     trace_count,
 )
+from rapid_tpu.engine.fleet import (
+    FleetMember,
+    fleet_simulate,
+    fleet_trace_count,
+    lower_schedule,
+    member_logs,
+    reset_fleet_trace_count,
+    stack_members,
+)
 from rapid_tpu.engine.topology import (build_topology, rank_and_insert,
                                        ring_permutations)
 
@@ -48,6 +57,7 @@ __all__ = [
     "ChurnSchedule",
     "EngineFaults",
     "EngineState",
+    "FleetMember",
     "INVARIANT_BITS",
     "InvariantViolationError",
     "StepLog",
@@ -57,12 +67,18 @@ __all__ = [
     "describe_bits",
     "empty_schedule",
     "engine_step",
+    "fleet_simulate",
+    "fleet_trace_count",
     "init_state",
+    "lower_schedule",
+    "member_logs",
     "plan_churn",
     "rank_and_insert",
+    "reset_fleet_trace_count",
     "reset_trace_count",
     "ring_permutations",
     "simulate",
+    "stack_members",
     "state_config_id",
     "step",
     "synthetic_churn_schedule",

@@ -1,0 +1,270 @@
+"""Fleet mode: a batch of independent clusters as one XLA program.
+
+One engine dispatch normally simulates one cluster. Fleet mode ``vmap``s
+the jitted ``lax.scan`` tick loop (``step.fleet_body``) over a leading
+fleet axis ``F``, so F clusters — each with its own fault script, churn
+mix and scripted consensus — advance together in a single device
+program. The tick body is traced exactly once regardless of F; adding
+clusters grows an XLA batch dimension, not compile time.
+
+Adversary lowering
+------------------
+``lower_schedule`` compiles an unscripted ``faults.AdversarySchedule``
+straight into the device pytrees the scan already consumes — no host
+planner, no per-tick host loop:
+
+- crashes -> ``EngineFaults.crash_tick`` (padded to capacity with the
+  never-sentinel);
+- directed / flip-flop partitions -> the ``LinkWindow`` tensors
+  ``state.link_faults`` lowers (``link_src/dst/start/end/period``);
+- scripted proposes -> a single-instance ``FallbackSchedule``: the
+  explicit ``delay_ticks`` becomes the per-slot fallback timer, the
+  distinct proposals become the fingerprint table rows, and
+  ``inst_epoch = 0`` gates the instance on the boot configuration
+  exactly like the oracle's config-id filter (a decide before the
+  propose tick expires it);
+- planner-scripted churn joins/leaves ride along as the per-member
+  ``ChurnSchedule`` (see ``campaign.py`` for the sampled mixes).
+
+Slot identities default to the differential harness universe
+(``diff.default_endpoints`` / ``default_node_ids``), so a lowered member
+is the device twin of exactly the scenario the host adversary referees.
+
+Fidelity envelope
+-----------------
+The fleet runs the *shared-state* step (see ``state.py``): exact for
+crash, scripted-propose and scheduled-churn scenarios; for link faults
+the shared kernel applies the window masks to its failure-detector
+probes but keeps one shared cut/consensus state, so partition members
+are a benchmark-scale approximation. The per-receiver host adversary
+(``engine.adversary`` via ``diff.run_adversarial_differential``) stays
+the exactness referee: campaigns spot-check sampled members against it
+per slot (``rapid_tpu.campaign``), which is the only part of a campaign
+that remains host-side.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from rapid_tpu.engine import churn as churn_mod
+from rapid_tpu.engine import paxos as paxos_mod
+from rapid_tpu.engine.state import (EngineFaults, EngineState, init_state,
+                                    link_faults, pad_link_windows)
+from rapid_tpu.engine.step import (_fleet_simulate, fleet_trace_count,
+                                   reset_fleet_trace_count)
+from rapid_tpu.faults import AdversarySchedule, validate_schedule
+from rapid_tpu.settings import Settings
+
+__all__ = [
+    "FleetMember",
+    "fleet_simulate",
+    "fleet_trace_count",
+    "lower_schedule",
+    "member_logs",
+    "reset_fleet_trace_count",
+    "stack_members",
+]
+
+
+class FleetMember(NamedTuple):
+    """One cluster's complete device program: state + lowered scripts.
+
+    A plain pytree; ``stack_members`` turns a list of these into the
+    batched fleet pytree ``fleet_simulate`` consumes. ``churn`` and
+    ``fallback`` are always present (inert schedules instead of None) so
+    every member shares one treedef.
+    """
+
+    state: EngineState
+    faults: EngineFaults
+    churn: churn_mod.ChurnSchedule
+    fallback: paxos_mod.FallbackSchedule
+
+
+def _default_identities(n: int):
+    """The differential-harness identity universe for an n-slot scenario."""
+    from rapid_tpu.engine.diff import default_endpoints, default_node_ids
+    from rapid_tpu.oracle.membership_view import id_fingerprint, uid_of
+
+    uids = [uid_of(e) for e in default_endpoints(n)]
+    id_fp_sum = sum(id_fingerprint(nid)
+                    for nid in default_node_ids(n)) & ((1 << 64) - 1)
+    return uids, id_fp_sum
+
+
+def _compile_proposes(schedule: AdversarySchedule, uids_np: np.ndarray,
+                      c: int) -> paxos_mod.FallbackSchedule:
+    """Scripted proposes -> one consensus instance gated on epoch 0.
+
+    The explicit ``ScriptedPropose.delay_ticks`` is the oracle's
+    ``recovery_delay_ticks``, so no jitter table is involved: both sides
+    share the same deterministic timer arithmetic. Distinct proposals
+    become fingerprint-table rows; split camps whose per-proposal tally
+    stays under the fast quorum recover through the device classic
+    chain (phase 1a/1b/2a/2b in ``engine.paxos``).
+    """
+    values = sorted({tuple(p.proposal) for p in schedule.proposes})
+    sched = paxos_mod.empty_fallback_schedule(c, instances=1,
+                                              pids=max(1, len(values)))
+    if not values:
+        return sched
+    pid_of = {v: i for i, v in enumerate(values)}
+    for p in schedule.proposes:
+        sched.prop_tick[0, p.slot] = p.tick
+        sched.prop_pid[0, p.slot] = pid_of[tuple(p.proposal)]
+        sched.prop_delay[0, p.slot] = p.delay_ticks
+    for v, pid in pid_of.items():
+        sched.table_mask[0, pid, list(v)] = True
+    paxos_mod._fingerprint_tables(sched, uids_np, c)
+    return sched
+
+
+def lower_schedule(schedule: AdversarySchedule, settings: Settings, *,
+                   churn: Optional[churn_mod.ChurnSchedule] = None,
+                   id_fps: Optional[np.ndarray] = None,
+                   uids: Optional[Sequence[int]] = None,
+                   id_fp_sum: Optional[int] = None) -> FleetMember:
+    """Compile one ``AdversarySchedule`` into a device ``FleetMember``.
+
+    ``uids``/``id_fp_sum`` default to the differential-harness universe
+    so the member is the device twin of the scenario
+    ``diff.run_adversarial_differential`` replays. ``churn`` (with its
+    dormant-slot ``id_fps``) rides along; it must carry no redraw script
+    (fleet members batch with one treedef) and defaults to the inert
+    schedule. The universe is padded to ``settings.capacity`` when that
+    exceeds ``schedule.n``.
+    """
+    validate_schedule(schedule)
+    n = schedule.n
+    if uids is None:
+        uids, default_sum = _default_identities(n)
+        if id_fp_sum is None:
+            id_fp_sum = default_sum
+    elif id_fp_sum is None:
+        id_fp_sum = 0
+    c = max(settings.capacity, n)
+    eff = settings if settings.capacity == c else settings.with_(capacity=c)
+
+    if id_fps is not None and len(id_fps) > len(uids):
+        # id_fps spanning the padded universe (synthetic churn schedules
+        # cover dormant slots too): extend the uid list with init_state's
+        # own pad rule so the two stay slot-aligned.
+        from rapid_tpu import hashing
+
+        uids = list(uids) + [hashing.hash64(i, seed=0x636170)
+                             for i in range(len(id_fps) - len(uids))]
+    state = init_state(uids, id_fp_sum, eff, id_fps=id_fps)
+    uids_np = _uids_np_from_state(state)
+
+    crash = np.full(c, np.iinfo(np.int32).max, np.int64)
+    crash[:n] = schedule.crash_tick_array()
+    faults = link_faults(crash.tolist(), schedule.windows, c)
+    fallback = _compile_proposes(schedule, uids_np, c)
+    if churn is None:
+        churn = churn_mod.empty_schedule(c)
+    elif churn.redraw_tick is not None:
+        raise ValueError("fleet members cannot carry redraw scripts "
+                         "(treedefs must match across the fleet axis)")
+    return FleetMember(state=state, faults=faults, churn=churn,
+                       fallback=fallback)
+
+
+def _uids_np_from_state(state: EngineState) -> np.ndarray:
+    """Recover the padded uint64 uid universe from a booted state."""
+    from rapid_tpu import hashing
+
+    return hashing.np_from_limbs(np.asarray(state.uid_hi),
+                                 np.asarray(state.uid_lo))
+
+
+def _pad_fallback(sched: paxos_mod.FallbackSchedule, n_inst: int,
+                  n_pids: int) -> paxos_mod.FallbackSchedule:
+    """Pad instances/pids so fallback pytrees batch across the fleet.
+
+    Pad instances get negative ``inst_epoch`` (the epoch counter never
+    goes negative, so they are dead rows); pad pids are all-False mask
+    rows no ``prop_pid`` ever points at.
+    """
+    i0, p0 = sched.table_mask.shape[0], sched.table_mask.shape[1]
+    c = sched.table_mask.shape[2]
+    if (i0, p0) == (n_inst, n_pids):
+        return sched
+    i_pad, p_pad = n_inst - i0, n_pids - p0
+    if i_pad < 0 or p_pad < 0:
+        raise ValueError("cannot shrink a fallback schedule")
+    i32max = np.iinfo(np.int32).max
+
+    def pad_ic(a, fill):
+        return np.concatenate(
+            [a, np.full((i_pad, c), fill, a.dtype)], axis=0)
+
+    mask = np.concatenate(
+        [sched.table_mask, np.zeros((i0, p_pad, c), bool)], axis=1)
+    mask = np.concatenate([mask, np.zeros((i_pad, n_pids, c), bool)], axis=0)
+    hi = np.concatenate(
+        [sched.table_hi, np.zeros((i0, p_pad), np.uint32)], axis=1)
+    hi = np.concatenate([hi, np.zeros((i_pad, n_pids), np.uint32)], axis=0)
+    lo = np.concatenate(
+        [sched.table_lo, np.zeros((i0, p_pad), np.uint32)], axis=1)
+    lo = np.concatenate([lo, np.zeros((i_pad, n_pids), np.uint32)], axis=0)
+    return paxos_mod.FallbackSchedule(
+        inst_epoch=np.concatenate(
+            [sched.inst_epoch, -np.arange(1, i_pad + 1, dtype=np.int32)]),
+        prop_tick=pad_ic(sched.prop_tick, i32max),
+        prop_pid=pad_ic(sched.prop_pid, -1),
+        prop_delay=pad_ic(sched.prop_delay, 0),
+        table_mask=mask, table_hi=hi, table_lo=lo)
+
+
+def stack_members(members: Sequence[FleetMember]) -> FleetMember:
+    """Stack per-cluster pytrees along a new leading fleet axis.
+
+    Members must share capacity, K and fault configuration (the static
+    aux data of ``EngineFaults``); link-window counts and fallback
+    instance/pid counts are padded to the fleet max with inert rows so
+    all treedefs (and shapes) match before ``jnp.stack``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not members:
+        raise ValueError("empty fleet")
+    c0 = int(members[0].state.member.shape[0])
+    for m in members:
+        if int(m.state.member.shape[0]) != c0:
+            raise ValueError("fleet members must share one capacity")
+        if m.churn.redraw_tick is not None:
+            raise ValueError("fleet members cannot carry redraw scripts")
+    w = max(m.faults.n_windows for m in members)
+    n_inst = max(m.fallback.inst_epoch.shape[0] for m in members)
+    n_pids = max(m.fallback.table_mask.shape[1] for m in members)
+    members = [
+        m._replace(faults=pad_link_windows(m.faults, w),
+                   fallback=_pad_fallback(m.fallback, n_inst, n_pids))
+        for m in members
+    ]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *members)
+
+
+def fleet_simulate(fleet: FleetMember, n_ticks: int,
+                   settings: Settings) -> tuple:
+    """Run every fleet member ``n_ticks`` ticks in one jitted dispatch.
+
+    ``fleet`` is the batched pytree from ``stack_members``. Returns
+    ``(final_states, logs)`` where every leaf carries a leading fleet
+    axis: states are ``[F, ...]``, logs are member-major ``[F, T, ...]``.
+    The tick body compiles once per (shape, settings) — re-dispatching
+    with fresh scenarios of the same shape is compile-free.
+    """
+    return _fleet_simulate(fleet.state, fleet.faults, fleet.churn,
+                           fleet.fallback, int(n_ticks), settings)
+
+
+def member_logs(logs, i: int):
+    """Slice member ``i``'s ``[T, ...]`` StepLog out of fleet logs."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: x[i], logs)

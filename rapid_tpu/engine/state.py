@@ -443,3 +443,43 @@ def link_faults(crash_ticks: Sequence[int], windows,
         link_src=jnp.asarray(src), link_dst=jnp.asarray(dst),
         link_start=jnp.asarray(start), link_end=jnp.asarray(end),
         link_period=jnp.asarray(period), link_two_way=jnp.asarray(two_way))
+
+
+def pad_link_windows(faults: EngineFaults, w: int) -> EngineFaults:
+    """Pad the link-window tensors to exactly ``w`` rows with inert windows.
+
+    An inert window has empty endpoint sets and ``start == end == 0``, so
+    it is never active, blocks no edge, and contributes zero to
+    ``partitioned_edge_count``. Fleet mode (``rapid_tpu.engine.fleet``)
+    stacks member fault pytrees with ``jnp.stack``, which requires every
+    member to share one treedef and shape — padding all members to the
+    fleet's max W is how schedules with different window counts batch.
+    ``w == n_windows`` is a no-op; shrinking is an error.
+    """
+    import jax.numpy as jnp
+
+    cur = faults.n_windows
+    if w == cur:
+        return faults
+    if w < cur:
+        raise ValueError(f"cannot shrink {cur} link windows to {w}")
+    c = int(faults.crash_tick.shape[0])
+    pad = w - cur
+
+    def grow(existing, fill_dtype, row_shape):
+        tail = jnp.zeros((pad,) + row_shape, fill_dtype)
+        if existing is None:
+            return tail
+        return jnp.concatenate([existing, tail], axis=0)
+
+    return EngineFaults(
+        crash_tick=faults.crash_tick,
+        drop_p=faults.drop_p, drop_seed=faults.drop_seed,
+        drop_targets=faults.drop_targets,
+        drop_ingress=faults.drop_ingress, drop_egress=faults.drop_egress,
+        link_src=grow(faults.link_src, bool, (c,)),
+        link_dst=grow(faults.link_dst, bool, (c,)),
+        link_start=grow(faults.link_start, jnp.int32, ()),
+        link_end=grow(faults.link_end, jnp.int32, ()),
+        link_period=grow(faults.link_period, jnp.int32, ()),
+        link_two_way=grow(faults.link_two_way, bool, ()))

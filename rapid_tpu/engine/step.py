@@ -409,3 +409,52 @@ def simulate(state: EngineState, faults: EngineFaults, n_ticks: int,
     the respective subsystem out.
     """
     return _simulate(state, faults, int(n_ticks), settings, churn, fallback)
+
+
+# ---------------------------------------------------------------------------
+# fleet axis: vmap the scanned step over a leading batch of clusters
+# ---------------------------------------------------------------------------
+
+_FLEET_TRACE_COUNT = 0
+
+
+def fleet_trace_count() -> int:
+    """How many times the fleet body has been traced (re-compiled)."""
+    return _FLEET_TRACE_COUNT
+
+
+def reset_fleet_trace_count() -> None:
+    """Zero the fleet trace counter (see ``reset_trace_count``)."""
+    global _FLEET_TRACE_COUNT
+    _FLEET_TRACE_COUNT = 0
+
+
+def fleet_body(states, faults, churn, fallback, n_ticks: int,
+               settings: Settings):
+    """The un-jitted fleet computation: ``vmap(scan(step))``.
+
+    Every argument is a pytree whose leaves carry a leading fleet axis
+    ``F`` (built by ``rapid_tpu.engine.fleet.stack_members``); the tick
+    body is traced exactly once regardless of F — batching is an XLA
+    dimension, not a python loop. ``churn`` and ``fallback`` are
+    mandatory here (fleet members use inert schedules rather than None)
+    so all members share one treedef. Exposed un-jitted so tests can
+    ``jax.make_jaxpr`` it and prove the jaxpr size is F-invariant.
+    """
+    global _FLEET_TRACE_COUNT
+    _FLEET_TRACE_COUNT += 1
+
+    def one(state, member_faults, member_churn, member_fallback):
+        def body(carry, _):
+            return step(carry, member_faults, settings, member_churn,
+                        member_fallback)
+
+        return lax.scan(body, state, None, length=n_ticks)
+
+    return jax.vmap(one)(states, faults, churn, fallback)
+
+
+@partial(jax.jit, static_argnums=(4, 5))
+def _fleet_simulate(states, faults, churn, fallback, n_ticks: int,
+                    settings: Settings):
+    return fleet_body(states, faults, churn, fallback, n_ticks, settings)

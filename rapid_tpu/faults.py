@@ -468,6 +468,132 @@ def random_adversary_schedule(n: int, seed: int, ticks: int,
     return schedule
 
 
+@dataclass(frozen=True)
+class ScenarioWeights:
+    """Sampling weights over the scenario-space kinds of
+    ``sample_adversary_schedule``. Zero removes a kind; weights need not
+    normalize. The default mix exercises every kind."""
+
+    crash: float = 1.0
+    partition: float = 1.0
+    flip_flop: float = 1.0
+    contested: float = 1.0
+    churn: float = 1.0
+
+    def items(self) -> Tuple[Tuple[str, float], ...]:
+        pairs = (("crash", self.crash), ("partition", self.partition),
+                 ("flip_flop", self.flip_flop), ("contested", self.contested),
+                 ("churn", self.churn))
+        out = tuple((k, w) for k, w in pairs if w > 0)
+        if not out:
+            raise ValueError("all scenario weights are zero")
+        return out
+
+
+DEFAULT_SCENARIO_WEIGHTS = ScenarioWeights()
+
+
+@dataclass(frozen=True)
+class SampledScenario:
+    """One draw from scenario space: the fault program plus the sampled
+    kind and whether the campaign should pair it with a churn schedule
+    (churn lives in ``engine.churn.ChurnSchedule``, outside the
+    ``AdversarySchedule`` surface the host referee replays)."""
+
+    kind: str
+    schedule: AdversarySchedule
+    wants_churn: bool = False
+
+
+def _sample_crash_burst(rng, n: int, fd_interval: int) -> List[Tuple[int, int]]:
+    crashes: List[Tuple[int, int]] = []
+    n_crash = rng.randint(1, max(1, n // 16))
+    burst_start = rng.randint(1, max(1, fd_interval))
+    for slot in rng.sample(range(n), n_crash):
+        tick = burst_start + (fd_interval if rng.random() < 0.5 else 0)
+        crashes.append((slot, tick))
+    return sorted(crashes)
+
+
+def sample_adversary_schedule(
+        n: int, seed: int, ticks: int,
+        weights: Optional[ScenarioWeights] = None,
+        fd_interval: int = 10) -> SampledScenario:
+    """Seeded scenario-space sampler for Monte-Carlo fleet campaigns.
+
+    Draws a scenario *kind* from ``weights`` and fills in its knobs
+    (burst sizes, partition subsets and healing, flip-flop periods,
+    contested camp splits with explicit fallback delays) from the same
+    ``random.Random(seed)`` stream — fully deterministic in ``seed``.
+    Every returned schedule passes ``validate_schedule`` (property-tested
+    in ``tests/test_fleet.py``). ``random_adversary_schedule`` above is
+    the fixed crash+partition mix the adversary tests pin; this sampler
+    is the campaign-facing superset.
+    """
+    import random as _random
+
+    weights = weights or DEFAULT_SCENARIO_WEIGHTS
+    rng = _random.Random(seed)
+    pairs = weights.items()
+    kind = rng.choices([k for k, _ in pairs], [w for _, w in pairs])[0]
+
+    crashes: List[Tuple[int, int]] = []
+    windows: List[LinkWindow] = []
+    proposes: List[ScriptedPropose] = []
+    wants_churn = False
+    if kind == "crash":
+        crashes = _sample_crash_burst(rng, n, fd_interval)
+    elif kind == "partition":
+        size = rng.randint(2, max(2, n // 3))
+        iso = frozenset(rng.sample(range(n), size))
+        rest = frozenset(range(n)) - iso
+        end = _NEVER_TICK
+        if rng.random() < 0.3:  # sometimes the partition heals mid-run
+            end = max(2, ticks // 2)
+        windows.append(LinkWindow(
+            src_slots=rest, dst_slots=iso,
+            start_tick=rng.randint(1, fd_interval), end_tick=end,
+            two_way=rng.random() < 0.3))
+        if rng.random() < 0.5:
+            crashes = _sample_crash_burst(rng, n, fd_interval)
+    elif kind == "flip_flop":
+        size = rng.randint(1, max(1, n // 8))
+        t = frozenset(rng.sample(range(n), size))
+        windows.append(LinkWindow(
+            src_slots=frozenset(range(n)) - t, dst_slots=t,
+            start_tick=rng.randint(1, max(1, ticks // 2)),
+            period_ticks=rng.randint(1, 4) * fd_interval,
+            two_way=rng.random() < 0.5))
+        if rng.random() < 0.3:
+            crashes = _sample_crash_burst(rng, n, fd_interval)
+    elif kind == "contested":
+        # Split the electorate into camps proposing conflicting removals:
+        # no camp reaches the fast quorum, timers with explicit delays
+        # fire, and the classic-Paxos fallback recovers.
+        n_camps = rng.randint(2, 3)
+        victims = sorted(rng.sample(range(n), n_camps))
+        tick0 = rng.randint(2, max(2, fd_interval))
+        for slot in range(n):
+            camp = rng.randrange(n_camps)
+            proposes.append(ScriptedPropose(
+                slot=slot, tick=tick0, proposal=(victims[camp],),
+                delay_ticks=rng.randint(1, 3 * fd_interval)))
+    elif kind == "churn":
+        wants_churn = True
+        if rng.random() < 0.4:  # churn under a light late crash
+            slot = rng.randrange(n)
+            crashes = [(slot, rng.randint(1, max(1, fd_interval)))]
+    else:  # pragma: no cover - items() only yields the kinds above
+        raise AssertionError(kind)
+
+    schedule = AdversarySchedule(
+        n=n, crashes=tuple(crashes), windows=tuple(windows),
+        proposes=tuple(proposes), seed=seed)
+    validate_schedule(schedule)
+    return SampledScenario(kind=kind, schedule=schedule,
+                           wants_churn=wants_churn)
+
+
 # ---------------------------------------------------------------------------
 # Deterministic Bernoulli sampling shared host/device
 # ---------------------------------------------------------------------------

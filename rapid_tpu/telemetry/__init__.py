@@ -29,9 +29,12 @@ from rapid_tpu.telemetry.metrics import (
     TickMetrics,
     counters_equal,
     engine_metrics,
+    fleet_summaries,
+    merge_summaries,
     oracle_metrics,
     read_jsonl,
     summarize,
+    summary_distributions,
     write_jsonl,
 )
 from rapid_tpu.telemetry.trace import (
@@ -52,10 +55,13 @@ __all__ = [
     "UNOBSERVED",
     "counters_equal",
     "engine_metrics",
+    "fleet_summaries",
     "jax_profiler_trace",
+    "merge_summaries",
     "oracle_metrics",
     "read_jsonl",
     "summarize",
+    "summary_distributions",
     "trace_from_logs",
     "wall_span",
     "write_jsonl",

@@ -333,3 +333,122 @@ def summarize(metrics: Sequence[TickMetrics]) -> RunSummary:
         max_partitioned_edges=max_part_edges,
         total_link_dropped=link_dropped_total,
     )
+
+
+# ---------------------------------------------------------------------------
+# fleet folds: many independent clusters, one aggregate + distributions
+# ---------------------------------------------------------------------------
+
+
+def fleet_summaries(logs) -> List[RunSummary]:
+    """Per-member ``RunSummary`` list from member-major fleet logs.
+
+    ``logs`` is the StepLog pytree returned by ``fleet_simulate`` — every
+    field carries a leading ``[F, T, ...]`` fleet axis. Each member's
+    slice runs through the exact single-run ``engine_metrics`` ->
+    ``summarize`` pipeline, so fleet aggregation is a pure fold over
+    per-run summaries, never a new counting rule.
+    """
+    fields = [np.asarray(x) for x in logs]
+    cls = type(logs)
+    return [summarize(engine_metrics(cls(*(x[i] for x in fields))))
+            for i in range(fields[0].shape[0])]
+
+
+def merge_summaries(summaries: Sequence[RunSummary],
+                    source: str = "fleet") -> RunSummary:
+    """Fold per-member summaries into one fleet aggregate.
+
+    Counter-like fields (messages, announcements, decisions,
+    invariant-violation ticks, per-phase fallback traffic,
+    ``total_link_dropped``) sum across the fleet axis; peak gauges
+    (``max_partitioned_edges``) take the max — summing a peak across
+    independent clusters would fabricate an edge count no cluster ever
+    saw. The semantics of every gauge are pinned in
+    ``telemetry.schema.GAUGE_SEMANTICS``. ``ticks_to_first_*`` become
+    the fleet-wide minima (earliest member); per-member values live in
+    ``summary_distributions``. ``view_changes`` rows are dropped from
+    the merge — across independent clusters they are a distribution,
+    not a sequence.
+    """
+    if not summaries:
+        raise ValueError("cannot merge an empty fleet")
+    decisions = sum(s.decisions for s in summaries)
+    window_sent = sum(v["messages_sent"] for s in summaries
+                      for v in s.view_changes)
+    firsts_a = [s.ticks_to_first_announce for s in summaries
+                if s.ticks_to_first_announce is not None]
+    firsts_d = [s.ticks_to_first_decide for s in summaries
+                if s.ticks_to_first_decide is not None]
+    phases = sorted({p for s in summaries for p in s.fallback_phase_sent})
+    return RunSummary(
+        source=source,
+        n_ticks=max(s.n_ticks for s in summaries),
+        announcements=sum(s.announcements for s in summaries),
+        decisions=decisions,
+        ticks_to_first_announce=min(firsts_a) if firsts_a else None,
+        ticks_to_first_decide=min(firsts_d) if firsts_d else None,
+        messages_per_view_change=(window_sent / decisions
+                                  if decisions else None),
+        view_changes=[],
+        total_sent=sum(s.total_sent for s in summaries),
+        total_delivered=sum(s.total_delivered for s in summaries),
+        total_dropped=sum(s.total_dropped for s in summaries),
+        total_timeouts=sum(s.total_timeouts for s in summaries),
+        total_probes_sent=sum(s.total_probes_sent for s in summaries),
+        total_probes_failed=sum(s.total_probes_failed for s in summaries),
+        invariant_violations=sum(s.invariant_violations for s in summaries),
+        fallback_phase_sent={
+            p: sum(s.fallback_phase_sent.get(p, 0) for s in summaries)
+            for p in phases},
+        max_partitioned_edges=max(s.max_partitioned_edges
+                                  for s in summaries),
+        total_link_dropped=sum(s.total_link_dropped for s in summaries),
+    )
+
+
+def _nearest_rank(values: Sequence[float], pct: float) -> float:
+    """Nearest-rank percentile over a non-empty sorted list —
+    deterministic (no interpolation), so campaign payloads diff
+    exactly."""
+    idx = max(0, -(-int(pct * len(values)) // 100) - 1)
+    return values[min(idx, len(values) - 1)]
+
+
+def _dist(values: Sequence[float]) -> Dict[str, object]:
+    vals = sorted(values)
+    if not vals:
+        return {"count": 0, "p50": None, "p90": None, "p99": None,
+                "max": None}
+    return {"count": len(vals),
+            "p50": _nearest_rank(vals, 50), "p90": _nearest_rank(vals, 90),
+            "p99": _nearest_rank(vals, 99), "max": vals[-1]}
+
+
+def summary_distributions(
+        summaries: Sequence[RunSummary]) -> Dict[str, object]:
+    """Campaign distributions over per-member summaries (Rapid §6 /
+    Paxos-in-the-cloud style empirical quantities): ticks-to-decide
+    percentiles, message-complexity tails, invariant-violation and
+    fallback rates. Percentiles are nearest-rank, so the payload is
+    bit-deterministic for a fixed campaign seed."""
+    n = len(summaries)
+    decided = [s for s in summaries if s.ticks_to_first_decide is not None]
+    fallback = [s for s in summaries
+                if sum(v for p, v in s.fallback_phase_sent.items()
+                       if p != "fast_vote") > 0]
+    violated = [s for s in summaries if s.invariant_violations > 0]
+    return {
+        "clusters": n,
+        "decided_clusters": len(decided),
+        "decide_rate": len(decided) / n if n else None,
+        "fallback_clusters": len(fallback),
+        "violation_rate": len(violated) / n if n else None,
+        "ticks_to_first_decide": _dist(
+            [s.ticks_to_first_decide for s in decided]),
+        "total_sent": _dist([s.total_sent for s in summaries]),
+        "messages_per_view_change": _dist(
+            [s.messages_per_view_change for s in summaries
+             if s.messages_per_view_change is not None]),
+        "decisions": _dist([s.decisions for s in summaries]),
+    }

@@ -25,7 +25,30 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
+
+#: Fold semantics of every RunSummary gauge when aggregated over a fleet
+#: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
+#: across independent clusters; "max" gauges are per-cluster peaks where
+#: a sum would fabricate a value no cluster ever observed; "min" gauges
+#: are earliest-member times whose per-member spread belongs in the
+#: campaign distributions instead.
+GAUGE_SEMANTICS = {
+    "announcements": "total",
+    "decisions": "total",
+    "ticks_to_first_announce": "min",
+    "ticks_to_first_decide": "min",
+    "total_sent": "total",
+    "total_delivered": "total",
+    "total_dropped": "total",
+    "total_timeouts": "total",
+    "total_probes_sent": "total",
+    "total_probes_failed": "total",
+    "invariant_violations": "total",
+    "fallback_phase_sent": "total",       # per phase
+    "max_partitioned_edges": "max",       # peak per-tick gauge
+    "total_link_dropped": "total",
+}
 
 #: RunSummary.as_dict() — the per-run "telemetry" block.
 TELEMETRY_SPEC = {
@@ -107,6 +130,39 @@ PROFILE_SWEEP_SPEC = {
 }
 
 
+#: Fleet-campaign block embedded in a fleet run payload under
+#: ``"campaign"`` (``rapid_tpu.campaign.run_campaign``).
+CAMPAIGN_SPEC = {
+    "seed": (int,),
+    "clusters": (int,),
+    "fleet_size": (int,),
+    "dispatches": (int,),
+    "scenario_kinds": (dict,),
+    "spot_checks": (dict,),
+    "distributions": (dict,),
+}
+
+SPOT_CHECK_SPEC = {
+    "requested": (int,),
+    "run": (int,),
+    "passed": (int,),
+    "members": (list,),
+}
+
+#: One nearest-rank distribution block (``metrics.summary_distributions``).
+DISTRIBUTION_SPEC = {
+    "count": (int,),
+    "p50": (int, float, type(None)),
+    "p90": (int, float, type(None)),
+    "p99": (int, float, type(None)),
+    "max": (int, float, type(None)),
+}
+
+#: Distribution keys every campaign payload must carry.
+CAMPAIGN_DISTRIBUTIONS = ("ticks_to_first_decide", "total_sent",
+                          "messages_per_view_change", "decisions")
+
+
 def _check(obj: Dict, spec: Dict, where: str) -> List[str]:
     errors = []
     if not isinstance(obj, dict):
@@ -137,12 +193,38 @@ def validate_telemetry(block, where: str = "telemetry") -> List[str]:
     return errors
 
 
+def validate_campaign(block, where: str = "campaign") -> List[str]:
+    errors = _check(block, CAMPAIGN_SPEC, where)
+    if not isinstance(block, dict):
+        return errors
+    kinds = block.get("scenario_kinds")
+    if isinstance(kinds, dict):
+        for kind, count in kinds.items():
+            if not isinstance(count, int) or isinstance(count, bool):
+                errors.append(f"{where}.scenario_kinds.{kind}: expected "
+                              f"int, got {type(count).__name__}")
+    if isinstance(block.get("spot_checks"), dict):
+        errors += _check(block["spot_checks"], SPOT_CHECK_SPEC,
+                         f"{where}.spot_checks")
+    dists = block.get("distributions")
+    if isinstance(dists, dict):
+        for key in CAMPAIGN_DISTRIBUTIONS:
+            if key not in dists:
+                errors.append(f"{where}.distributions.{key}: missing")
+            else:
+                errors += _check(dists[key], DISTRIBUTION_SPEC,
+                                 f"{where}.distributions.{key}")
+    return errors
+
+
 def validate_run_payload(payload, where: str = "payload") -> List[str]:
     errors = _check(payload, RUN_SPEC, where)
     if isinstance(payload, dict) and isinstance(payload.get("telemetry"),
                                                 dict):
         errors += validate_telemetry(payload["telemetry"],
                                      f"{where}.telemetry")
+    if isinstance(payload, dict) and "campaign" in payload:
+        errors += validate_campaign(payload["campaign"], f"{where}.campaign")
     return errors
 
 
@@ -199,7 +281,7 @@ def validate_bench_payload(payload) -> List[str]:
     if payload.get("bench") == "kernel_profile_sweep":
         return errors + validate_profile_payload(payload)
     if payload.get("bench") == "engine_tick_suite":
-        for key in ("steady", "churn", "contested", "partition"):
+        for key in ("steady", "churn", "contested", "partition", "fleet"):
             if key not in payload:
                 errors.append(f"payload.{key}: missing")
             else:

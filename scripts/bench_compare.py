@@ -43,7 +43,7 @@ from rapid_tpu.telemetry.schema import validate_bench_payload  # noqa: E402
 
 #: Run-config keys that must match for the count comparison to mean
 #: anything; a mismatch is an error telling the caller to regenerate.
-CONFIG_KEYS = ("n", "ticks", "k")
+CONFIG_KEYS = ("n", "ticks", "k", "clusters", "fleet_size")
 
 #: Deterministic protocol counts at the run level (compared when present
 #: on either side — scenarios carry different subsets).
@@ -92,6 +92,19 @@ def compare_run(current: Dict, baseline: Dict, where: str,
         if cur_tel.get(key) != base_tel.get(key):
             errors.append(f"{where}.telemetry.{key}: {cur_tel.get(key)!r} "
                           f"!= baseline {base_tel.get(key)!r}")
+
+    # Fleet campaigns: every field of the campaign block (scenario-kind
+    # counts, spot-check results, nearest-rank distributions) is derived
+    # from the campaign seed, so it must match exactly like any other
+    # protocol count.
+    if "campaign" in current or "campaign" in baseline:
+        cur_c = current.get("campaign") or {}
+        base_c = baseline.get("campaign") or {}
+        for key in sorted(set(cur_c) | set(base_c)):
+            if cur_c.get(key) != base_c.get(key):
+                errors.append(f"{where}.campaign.{key}: "
+                              f"{cur_c.get(key)!r} != baseline "
+                              f"{base_c.get(key)!r}")
 
     cur_tps = current.get("ticks_per_sec")
     base_tps = baseline.get("ticks_per_sec")
@@ -172,7 +185,7 @@ def compare_payloads(current: Dict, baseline: Dict,
     if cur_kind == "engine_tick_suite":
         errors: List[str] = []
         warnings: List[str] = []
-        for key in ("steady", "churn", "contested", "partition"):
+        for key in ("steady", "churn", "contested", "partition", "fleet"):
             e, w = compare_run(current.get(key) or {},
                                baseline.get(key) or {},
                                f"payload.{key}", tps_tolerance)

@@ -8,6 +8,9 @@ cd "$(dirname "$0")/.."
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || exit 1
+    echo LINT=ok
+else
+    echo LINT=skipped
 fi
 
 rm -f /tmp/_t1.log
@@ -63,6 +66,23 @@ if [ "$rc" -eq 0 ]; then
         echo PARTITION_SMOKE=ok
     else
         echo PARTITION_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Fleet-campaign smoke: a small Monte-Carlo campaign must sample the
+# scenario space, run as one vmapped dispatch, emit a schema-valid
+# campaign payload, and pass one oracle spot-check (the partition member
+# is replayed through run_adversarial_differential, which raises on any
+# per-slot divergence).
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 8 --fleet-size 8 --n 32 --ticks 160 \
+            --spot-checks 1 --out /tmp/_t1_fleet.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_fleet.json; then
+        echo FLEET_SMOKE=ok
+    else
+        echo FLEET_SMOKE=failed
         rc=1
     fi
 fi

@@ -1,0 +1,128 @@
+"""Campaign driver: determinism, schema v3 payloads, and fleet folds.
+
+The campaign block of a bench payload is exact-compared by
+``scripts/bench_compare.py``, so everything derived from the campaign
+seed — member scenarios, spot-check selection, nearest-rank
+distributions — must be bit-stable across processes and across the
+dispatch split. Wall-clock fields are the only permitted variation.
+"""
+import copy
+import json
+
+import pytest
+
+from rapid_tpu.campaign import CampaignConfig, run_campaign
+from rapid_tpu.telemetry import metrics as tmetrics
+from rapid_tpu.telemetry import schema as tschema
+from rapid_tpu.telemetry.metrics import (RunSummary, merge_summaries,
+                                         summary_distributions)
+
+#: Machine-dependent payload keys, excluded from determinism diffs.
+WALL_KEYS = ("boot_s", "wall_s", "fold_s", "spot_check_s", "ticks_per_sec",
+             "rounds_per_sec", "platform")
+
+TINY = CampaignConfig(clusters=6, n=16, ticks=80, seed=9, fleet_size=3,
+                      headroom=8, spot_checks=0)
+
+
+def _strip_wall(payload):
+    out = copy.deepcopy(payload)
+    for key in WALL_KEYS:
+        out.pop(key, None)
+    return out
+
+
+@pytest.fixture(scope="module")
+def tiny_payload():
+    return run_campaign(TINY)
+
+
+def test_campaign_is_deterministic_across_dispatches(tiny_payload):
+    """Same seed, two runs (each split into 2 dispatches of 3): every
+    non-wall field of the payload — merged telemetry, scenario-kind
+    counts, distributions — is bit-identical."""
+    assert tiny_payload["dispatches"] == 2
+    again = run_campaign(TINY)
+    assert json.dumps(_strip_wall(tiny_payload), sort_keys=True) == \
+        json.dumps(_strip_wall(again), sort_keys=True)
+
+
+def test_campaign_payload_passes_schema_v3(tiny_payload):
+    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 3
+    assert tschema.validate_bench_payload(tiny_payload) == []
+    camp = tiny_payload["campaign"]
+    assert camp["clusters"] == TINY.clusters
+    assert sum(camp["scenario_kinds"].values()) == TINY.clusters
+    dists = camp["distributions"]
+    assert dists["clusters"] == TINY.clusters
+    for key in tschema.CAMPAIGN_DISTRIBUTIONS:
+        assert set(dists[key]) == {"count", "p50", "p90", "p99", "max"}
+
+
+def _summary(**kw):
+    base = dict(source="engine", n_ticks=10, announcements=0, decisions=0,
+                ticks_to_first_announce=None, ticks_to_first_decide=None,
+                messages_per_view_change=None, view_changes=[],
+                total_sent=0, total_delivered=0, total_dropped=0,
+                total_timeouts=0, total_probes_sent=0,
+                total_probes_failed=0)
+    base.update(kw)
+    return RunSummary(**base)
+
+
+def test_merge_summaries_gauge_semantics():
+    """Counters sum, peak gauges take the max, firsts take the min —
+    exactly what GAUGE_SEMANTICS documents."""
+    a = _summary(decisions=1, announcements=2, total_sent=100,
+                 ticks_to_first_decide=30, invariant_violations=1,
+                 max_partitioned_edges=7, total_link_dropped=4,
+                 fallback_phase_sent={"fast_vote": 10, "phase1a": 3},
+                 view_changes=[{"messages_sent": 60}])
+    b = _summary(decisions=2, announcements=2, total_sent=50,
+                 ticks_to_first_decide=12, max_partitioned_edges=5,
+                 total_link_dropped=9,
+                 fallback_phase_sent={"fast_vote": 4},
+                 view_changes=[{"messages_sent": 20},
+                               {"messages_sent": 10}])
+    m = merge_summaries([a, b])
+    assert m.decisions == 3 and m.announcements == 4
+    assert m.total_sent == 150 and m.total_link_dropped == 13
+    assert m.invariant_violations == 1
+    assert m.max_partitioned_edges == 7        # max, never 12
+    assert m.ticks_to_first_decide == 12       # min, earliest member
+    assert m.fallback_phase_sent == {"fast_vote": 14, "phase1a": 3}
+    assert m.messages_per_view_change == pytest.approx(90 / 3)
+    assert m.view_changes == []                # a distribution, not a log
+    with pytest.raises(ValueError):
+        merge_summaries([])
+
+
+def test_gauge_semantics_covers_real_fields():
+    fields = set(RunSummary.__dataclass_fields__)
+    assert set(tschema.GAUGE_SEMANTICS) <= fields
+    # Every peak/min rule named in the schema is honoured by the fold
+    # above; anything not listed defaults to "total".
+    assert tschema.GAUGE_SEMANTICS["max_partitioned_edges"] == "max"
+    assert tschema.GAUGE_SEMANTICS["ticks_to_first_decide"] == "min"
+
+
+def test_nearest_rank_distributions_are_exact():
+    vals = [5, 1, 9, 3, 7]
+    d = tmetrics._dist(vals)
+    assert d == {"count": 5, "p50": 5, "p90": 9, "p99": 9, "max": 9}
+    empty = tmetrics._dist([])
+    assert empty["count"] == 0 and empty["p50"] is None
+
+
+def test_merged_telemetry_matches_member_fold(tiny_payload):
+    """The payload's merged telemetry block must agree with its own
+    distributions on the observables both report."""
+    tel = tiny_payload["telemetry"]
+    dists = tiny_payload["campaign"]["distributions"]
+    assert tel["source"] == "fleet"
+    assert tel["n_ticks"] == TINY.ticks
+    # every decided cluster contributes at least one decision to the sum
+    assert tel["decisions"] >= dists["decided_clusters"]
+    assert tiny_payload["decisions"] == tel["decisions"]
+    assert dists["ticks_to_first_decide"]["count"] == \
+        dists["decided_clusters"]

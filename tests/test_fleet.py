@@ -1,0 +1,186 @@
+"""Fleet mode: adversary lowering, vmapped batching, and the sampler.
+
+The core claims pinned here:
+
+- a fleet member's slice of a batched run is bit-identical to the same
+  scenario run through the unbatched ``simulate`` (vmap changes the
+  batch dimension, never the protocol);
+- the vmapped scan traces the tick body exactly once, and the jaxpr of
+  the fleet program does not grow with F (no per-member retrace or
+  unrolling);
+- inert padding (link windows, fallback instances/pids) added so
+  heterogeneous scenarios can batch never changes a member's outcome;
+- every draw of ``sample_adversary_schedule`` passes
+  ``validate_schedule`` and respects the kind weights.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import importlib
+
+from rapid_tpu.engine import fleet as fleet_mod
+from rapid_tpu.engine.state import pad_link_windows
+from rapid_tpu.engine.step import simulate
+
+# rapid_tpu.engine re-exports the `step` *function*, which shadows the
+# module under `from rapid_tpu.engine import step`.
+step_mod = importlib.import_module("rapid_tpu.engine.step")
+from rapid_tpu.faults import (AdversarySchedule, ScenarioWeights,
+                              ScriptedPropose, random_adversary_schedule,
+                              sample_adversary_schedule, validate_schedule)
+from rapid_tpu.settings import Settings
+
+SETTINGS = Settings()
+N = 16
+TICKS = 120
+
+
+def _contested_schedule(n: int, seed: int = 11) -> AdversarySchedule:
+    """Split votes: no fast quorum, explicit timers, classic fallback."""
+    return AdversarySchedule(n=n, proposes=tuple(
+        ScriptedPropose(slot=i, tick=5, proposal=(0,) if i % 2 else (1,),
+                        delay_ticks=4 + i % 3)
+        for i in range(n)), seed=seed)
+
+
+def _members(schedules):
+    return [fleet_mod.lower_schedule(s, SETTINGS) for s in schedules]
+
+
+def _assert_tree_equal(a, b, what):
+    for field, x, y in zip(type(a)._fields, a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{what}: field {field} diverged"
+
+
+def test_fleet_member_matches_unbatched_simulate():
+    """Slicing member i out of a fleet run == running it alone."""
+    schedules = [random_adversary_schedule(N, seed=3, ticks=TICKS),
+                 random_adversary_schedule(N, seed=7, ticks=TICKS),
+                 _contested_schedule(N)]
+    members = _members(schedules)
+    fleet = fleet_mod.stack_members(members)
+    finals, logs = fleet_mod.fleet_simulate(fleet, TICKS, SETTINGS)
+
+    w = max(m.faults.n_windows for m in members)
+    n_pids = max(m.fallback.table_mask.shape[1] for m in members)
+    for i, m in enumerate(members):
+        padded = m._replace(
+            faults=pad_link_windows(m.faults, w),
+            fallback=fleet_mod._pad_fallback(m.fallback, 1, n_pids))
+        final, log = simulate(padded.state, padded.faults, TICKS, SETTINGS,
+                              padded.churn, padded.fallback)
+        _assert_tree_equal(log, fleet_mod.member_logs(logs, i),
+                           f"member {i} logs")
+        _assert_tree_equal(
+            final, jax.tree_util.tree_map(lambda x: x[i], finals),
+            f"member {i} final state")
+
+
+def test_contested_member_decides_via_device_classic_chain():
+    """The lowered split-vote scenario recovers through the on-device
+    classic-Paxos phases (1a traffic + a decision), not the fast round."""
+    members = _members([_contested_schedule(N)])
+    _, logs = fleet_mod.fleet_simulate(fleet_mod.stack_members(members),
+                                       TICKS, SETTINGS)
+    log = fleet_mod.member_logs(logs, 0)
+    assert int(np.asarray(log.decide_now).sum()) >= 1
+    assert int(np.asarray(log.px1a_senders).sum()) > 0
+
+
+def test_fleet_traces_tick_body_exactly_once():
+    """F members, one trace: batching is an XLA dimension, not a loop."""
+    schedules = [random_adversary_schedule(N, seed=s, ticks=40)
+                 for s in range(6)]
+    fleet = fleet_mod.stack_members(_members(schedules))
+    step_mod.reset_trace_count()
+    fleet_mod.reset_fleet_trace_count()
+    finals, _ = fleet_mod.fleet_simulate(fleet, 40, SETTINGS)
+    jax.block_until_ready(finals)
+    assert fleet_mod.fleet_trace_count() == 1
+    assert step_mod.trace_count() == 1
+    # Re-dispatch with fresh scenarios of the same shape: zero retraces.
+    fleet2 = fleet_mod.stack_members(
+        _members([random_adversary_schedule(N, seed=s, ticks=40)
+                  for s in range(10, 16)]))
+    finals2, _ = fleet_mod.fleet_simulate(fleet2, 40, SETTINGS)
+    jax.block_until_ready(finals2)
+    assert fleet_mod.fleet_trace_count() == 1
+    assert step_mod.trace_count() == 1
+
+
+def test_fleet_jaxpr_size_is_f_invariant():
+    """The traced program must not grow with the fleet axis."""
+    def eqn_count(f):
+        fleet = fleet_mod.stack_members(
+            _members([random_adversary_schedule(N, seed=s, ticks=30)
+                      for s in range(f)]))
+        jaxpr = jax.make_jaxpr(
+            lambda st, fa, ch, fb: step_mod.fleet_body(
+                st, fa, ch, fb, 30, SETTINGS)
+        )(fleet.state, fleet.faults, fleet.churn, fleet.fallback)
+        return len(jaxpr.jaxpr.eqns)
+
+    assert eqn_count(2) == eqn_count(5)
+
+
+def test_inert_padding_changes_nothing():
+    """Window/instance/pid padding must be protocol-invisible."""
+    schedule = random_adversary_schedule(N, seed=5, ticks=TICKS)
+    m = fleet_mod.lower_schedule(schedule, SETTINGS)
+    padded = m._replace(
+        faults=pad_link_windows(m.faults, m.faults.n_windows + 2),
+        fallback=fleet_mod._pad_fallback(m.fallback, 3, 4))
+    base = simulate(m.state, m.faults, TICKS, SETTINGS, m.churn, m.fallback)
+    alt = simulate(padded.state, padded.faults, TICKS, SETTINGS,
+                   padded.churn, padded.fallback)
+    _assert_tree_equal(base[1], alt[1], "padded logs")
+    _assert_tree_equal(base[0], alt[0], "padded final state")
+
+
+def test_pad_link_windows_rejects_shrink():
+    m = fleet_mod.lower_schedule(
+        random_adversary_schedule(N, seed=1, ticks=60), SETTINGS)
+    if m.faults.n_windows == 0:
+        pytest.skip("seed drew no windows")
+    with pytest.raises(ValueError):
+        pad_link_windows(m.faults, m.faults.n_windows - 1)
+
+
+def test_sampled_schedules_all_validate():
+    """Property: every draw passes validate_schedule, over many seeds,
+    sizes and tick budgets; the default mix covers every kind."""
+    kinds = set()
+    for n, ticks in ((8, 60), (32, 300)):
+        for seed in range(150):
+            sc = sample_adversary_schedule(n, seed, ticks)
+            validate_schedule(sc.schedule)  # must not raise
+            assert sc.schedule.n == n
+            assert sc.schedule.seed == seed
+            kinds.add(sc.kind)
+    assert kinds == {"crash", "partition", "flip_flop", "contested",
+                     "churn"}
+
+
+def test_sampler_respects_weights_and_is_deterministic():
+    only_contested = ScenarioWeights(crash=0, partition=0, flip_flop=0,
+                                     contested=1, churn=0)
+    for seed in range(40):
+        sc = sample_adversary_schedule(N, seed, 200, only_contested)
+        assert sc.kind == "contested"
+        assert sc.schedule.proposes
+        again = sample_adversary_schedule(N, seed, 200, only_contested)
+        assert again == sc
+    with pytest.raises(ValueError):
+        ScenarioWeights(crash=0, partition=0, flip_flop=0, contested=0,
+                        churn=0).items()
+
+
+def test_churn_kind_flags_wants_churn():
+    only_churn = ScenarioWeights(crash=0, partition=0, flip_flop=0,
+                                 contested=0, churn=1)
+    sc = sample_adversary_schedule(N, 0, 200, only_churn)
+    assert sc.kind == "churn" and sc.wants_churn
+    assert not sc.schedule.windows and not sc.schedule.proposes

@@ -25,6 +25,14 @@ from rapid_tpu.engine.invariants import (
     check_step,
     describe_bits,
 )
+from rapid_tpu.engine.sharding import (
+    constrain,
+    constrain_tree,
+    shard_put,
+    slot_mesh,
+    spec_for,
+    state_shardings,
+)
 from rapid_tpu.engine.state import (
     EngineFaults,
     EngineState,
@@ -64,6 +72,8 @@ __all__ = [
     "build_topology",
     "check_run",
     "check_step",
+    "constrain",
+    "constrain_tree",
     "describe_bits",
     "empty_schedule",
     "engine_step",
@@ -77,9 +87,13 @@ __all__ = [
     "reset_fleet_trace_count",
     "reset_trace_count",
     "ring_permutations",
+    "shard_put",
     "simulate",
+    "slot_mesh",
+    "spec_for",
     "stack_members",
     "state_config_id",
+    "state_shardings",
     "step",
     "synthetic_churn_schedule",
     "trace_count",

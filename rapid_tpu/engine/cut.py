@@ -32,6 +32,7 @@ from __future__ import annotations
 
 from jax import lax
 
+from rapid_tpu.engine import sharding
 from rapid_tpu.engine.state import EngineState
 
 
@@ -67,7 +68,7 @@ def deliver_churn_reports(xp, state: EngineState, src_alive):
 
 
 def aggregate(xp, state: EngineState, delivered_down, delivered_up,
-              any_receiver, settings):
+              any_receiver, settings, mesh=None):
     """Apply one tick of reports; returns (reports, seen_down,
     announce_now, proposal, explicit_added, implicit_added).
 
@@ -79,8 +80,15 @@ def aggregate(xp, state: EngineState, delivered_down, delivered_up,
     report cells filled by delivered alerts this tick, ``implicit_added``
     the cells filled by the edge-invalidation fixpoint (telemetry gauges;
     neither feeds back into the protocol state).
+
+    ``mesh`` (static) partitions the capacity axis of the ``[C, K]``
+    report matrix across devices: the fixpoint's ``lax.while_loop``
+    carry is re-constrained every iteration so the per-destination count
+    reduction and the mask algebra stay sharded — only the
+    ``obs_in_sets`` gather crosses device boundaries.
     """
     lo, hi = settings.L, settings.H
+    c = state.member.shape[0]
     gate = any_receiver & ~state.announced
     new_down = delivered_down & state.member[:, None] & gate
     new_up = delivered_up & ~state.member[:, None] & gate
@@ -97,7 +105,7 @@ def aggregate(xp, state: EngineState, delivered_down, delivered_up,
         flux = (counts >= lo) & (counts < hi)
         obs_in_sets = (counts >= lo)[eff_obs]
         add = flux[:, None] & obs_in_sets & ~r
-        return r | add
+        return sharding.constrain(r | add, mesh, c)
 
     def fixpoint(r):
         def body(carry):

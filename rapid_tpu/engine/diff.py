@@ -362,12 +362,16 @@ def run_differential(
     crash_ticks: Dict[int, int],
     n_ticks: int,
     settings: Optional[Settings] = None,
+    mesh=None,
 ) -> DiffResult:
     """Replay a crash scenario through oracle and engine and collect both.
 
     ``crash_ticks`` maps slot index -> crash tick. Call
     ``result.assert_identical()`` for the bit-identical checks.
+    ``mesh`` (optional 1-D device mesh) runs the engine side sharded over
+    the slot axis — the differential then proves sharded == oracle.
     """
+    from rapid_tpu.engine import sharding as sharding_mod
     from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
     from rapid_tpu.engine.state import state_config_id
     from rapid_tpu.engine.step import simulate
@@ -391,7 +395,11 @@ def run_differential(
     id_fp_sum = clusters[0].membership_service.view._id_fp_sum
     state = init_state(uids, id_fp_sum, settings)
     faults = crash_faults([crash_ticks.get(s, I32_MAX) for s in range(n)])
-    final_state, logs = simulate(state, faults, n_ticks, settings)
+    if mesh is not None:
+        capacity = int(state.member.shape[0])
+        state = sharding_mod.shard_put(state, mesh, capacity)
+        faults = sharding_mod.shard_put(faults, mesh, capacity)
+    final_state, logs = simulate(state, faults, n_ticks, settings, mesh=mesh)
 
     from rapid_tpu.telemetry import metrics as telemetry_metrics
 

@@ -250,7 +250,7 @@ def stack_members(members: Sequence[FleetMember]) -> FleetMember:
 
 
 def fleet_simulate(fleet: FleetMember, n_ticks: int,
-                   settings: Settings) -> tuple:
+                   settings: Settings, mesh=None) -> tuple:
     """Run every fleet member ``n_ticks`` ticks in one jitted dispatch.
 
     ``fleet`` is the batched pytree from ``stack_members``. Returns
@@ -258,9 +258,14 @@ def fleet_simulate(fleet: FleetMember, n_ticks: int,
     axis: states are ``[F, ...]``, logs are member-major ``[F, T, ...]``.
     The tick body compiles once per (shape, settings) — re-dispatching
     with fresh scenarios of the same shape is compile-free.
+
+    ``mesh`` (static) shards every member's slot axis over the device
+    mesh while the fleet axis stays replicated (``P(None, 'slots')`` on
+    ``[F, C]`` leaves) — the vmapped campaign and the single-member run
+    produce bit-identical results either way.
     """
     return _fleet_simulate(fleet.state, fleet.faults, fleet.churn,
-                           fleet.fallback, int(n_ticks), settings)
+                           fleet.fallback, int(n_ticks), settings, mesh)
 
 
 def member_logs(logs, i: int):

@@ -67,6 +67,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from rapid_tpu import hashing
+from rapid_tpu.engine import sharding
 from rapid_tpu.engine.state import I32_MAX
 from rapid_tpu.engine.votes import fast_quorum, proposal_fingerprint, \
     segmented_vote_count
@@ -207,7 +208,7 @@ def _instance_row(xp, sched: FallbackSchedule, epoch):
     return e, live
 
 
-def chain_deliver(xp, state, sched: FallbackSchedule, t, n):
+def chain_deliver(xp, state, sched: FallbackSchedule, t, n, mesh=None):
     """Classic-chain deliveries at tick ``t``: 2b -> 2a -> 1b.
 
     These messages were sent during the previous tick's delivery phase,
@@ -217,6 +218,11 @@ def chain_deliver(xp, state, sched: FallbackSchedule, t, n):
     holds the phase-2a/2b sender factors generated by these deliveries.
     Later chain stages are gated off once an earlier message decided —
     the oracle's fresh consensus instance rejects their configuration id.
+
+    Per-slot rank/vote updates are elementwise selects over ``[C]``
+    arrays; ``mesh`` (static) pins the updated state to the slot
+    partition so the coordinator-rule reductions cannot pull the carry
+    back to a replicated layout.
     """
     epoch = state.epoch
     e, live = _instance_row(xp, sched, epoch)
@@ -272,18 +278,22 @@ def chain_deliver(xp, state, sched: FallbackSchedule, t, n):
         "px2b_senders": xp.where(arr2a, n_accept, 0).astype(xp.int32),
         "px2b_recipients": xp.where(arr2a, n, 0).astype(xp.int32),
     }
+    state = sharding.constrain_tree(state, mesh, state.member.shape[0])
     return state, counts, classic_decide, classic_pid
 
 
-def fast_tally(xp, state, sched: FallbackSchedule, t, n, blocked):
+def fast_tally(xp, state, sched: FallbackSchedule, t, n, blocked,
+               mesh=None):
     """Scripted fast-round tally at tick ``t`` (after chain messages,
     before phase-1a broadcasts, in seq order).
 
     The delivered-vote set is derived from the schedule (a vote sent at
     its propose tick arrives one tick later, and the instance epoch gate
     expires stale votes exactly as the oracle's configuration-id check).
-    Reuses the limb-fingerprint segmented counter from ``votes.py``.
-    Returns ``(fast_decide, win_pid, tally, quorum)``.
+    Reuses the limb-fingerprint segmented counter from ``votes.py``,
+    threading ``mesh`` (static) so the per-slot tally re-partitions
+    after the global sort. Returns ``(fast_decide, win_pid, tally,
+    quorum)``.
     """
     epoch = state.epoch
     e, live = _instance_row(xp, sched, epoch)
@@ -293,7 +303,8 @@ def fast_tally(xp, state, sched: FallbackSchedule, t, n, blocked):
     safe_pid = xp.clip(pid, 0, sched.table_mask.shape[1] - 1)
     vote_hi = sched.table_hi[e][safe_pid]
     vote_lo = sched.table_lo[e][safe_pid]
-    per_vote = segmented_vote_count(xp, vote_hi, vote_lo, delivered)
+    per_vote = segmented_vote_count(xp, vote_hi, vote_lo, delivered,
+                                    mesh=mesh)
     total = delivered.sum().astype(xp.int32)
     quorum = fast_quorum(xp, n)
     decided = ~blocked & (total >= quorum) & (per_vote.max() >= quorum)
@@ -303,11 +314,14 @@ def fast_tally(xp, state, sched: FallbackSchedule, t, n, blocked):
     return decided, win_pid, tally, quorum
 
 
-def phase1a_deliver(xp, state, sched: FallbackSchedule, t, n, decided_now):
+def phase1a_deliver(xp, state, sched: FallbackSchedule, t, n, decided_now,
+                    mesh=None):
     """Phase-1a delivery at tick ``t`` (last in seq order: the broadcast
     was a task-phase send). Acceptors with a lower rank promise and
     unicast phase 1b to the coordinator; a decision earlier this tick
-    (or an epoch change since the send) kills the broadcast in flight."""
+    (or an epoch change since the send) kills the broadcast in flight.
+    ``mesh`` (static) pins the promise-mask update to the slot
+    partition."""
     epoch = state.epoch
     _, live = _instance_row(xp, sched, epoch)
     arr1a = live & ~decided_now & (state.c1a_tick + 1 == t) \
@@ -324,16 +338,20 @@ def phase1a_deliver(xp, state, sched: FallbackSchedule, t, n, decided_now):
         c1b_epoch=xp.where(arr1a, epoch, state.c1b_epoch),
     )
     counts = {"px1b_senders": xp.where(arr1a, n_promise, 0).astype(xp.int32)}
+    state = sharding.constrain_tree(state, mesh, state.member.shape[0])
     return state, counts
 
 
-def task_phase(xp, state, sched: FallbackSchedule, t, n, decided_now):
+def task_phase(xp, state, sched: FallbackSchedule, t, n, decided_now,
+               mesh=None):
     """Task-phase sends at tick ``t``: scripted proposes (fast-round vote
     broadcast + own-vote registration + timer arming, in that order per
     the oracle's ``FastPaxos.propose``), then timer fires (phase-1a
     broadcast). Propose tasks hold pre-start scheduler handles, so they
     run before timer tasks due the same tick; a decision this tick
-    cancelled every timer before the task queue ran."""
+    cancelled every timer before the task queue ran. ``mesh`` (static)
+    pins the timer/rank updates to the slot partition after the
+    coordinator argmax/gather."""
     epoch = state.epoch
     e, live = _instance_row(xp, sched, epoch)
     pid = sched.prop_pid[e]
@@ -374,6 +392,7 @@ def task_phase(xp, state, sched: FallbackSchedule, t, n, decided_now):
         "px1a_senders": n_fire,
         "px1a_recipients": xp.where(any_fire, n, 0).astype(xp.int32),
     }
+    state = sharding.constrain_tree(state, mesh, state.member.shape[0])
     return state, counts
 
 

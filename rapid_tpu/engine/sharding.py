@@ -1,0 +1,183 @@
+"""Slot-universe sharding: the tick engine over a 1-D device mesh.
+
+The engine's working set is the flat *slot universe* — ``[C]`` and
+``[C, K]`` arrays indexed by slot — and every hot kernel the observatory
+names (``cut_aggregate`` tops FLOPs/bytes at every N, ``vote_count``
+tops wall clock at 10k/100k) is a slot-parallel reduction. This module
+partitions that capacity axis over a 1-D ``jax.sharding.Mesh`` so a
+v5e-8-shaped device set (or the 8 virtual CPU devices the test suite
+forces) each own ``C / n_devices`` slots:
+
+- **what shards**: any array whose leading-or-later axis equals the
+  capacity ``C`` — ``member``/``uid_*``/``fc [C, K]``/``reports
+  [C, K]``/``px_* [C]``, the fault tensors ``link_src [W, C]``, the
+  fallback script rows ``prop_tick [I, C]`` / ``table_mask [I, P, C]``;
+- **what replicates**: scalars (``tick``, the limb sums, latches), the
+  tiny per-instance fallback tables ``table_hi/lo [I, P]``, and — via
+  the divisibility guard — anything whose capacity axis does not divide
+  the mesh (a ``[256, 8]`` LUT constant never has a capacity axis and
+  is always replicated).
+
+One deliberately *non*-local axis remains: gathers like
+``fc[obs_idx]`` and the ``vote_count`` lexsort are cross-slot, so XLA
+inserts collectives for them — the win is that the elementwise bulk of
+``cut_aggregate``'s fixpoint and the monitor stays partitioned, and the
+``lax.scan`` carry keeps its sharding across ticks (committed input
+shardings + ``with_sharding_constraint`` on the carry, no per-tick
+reshard).
+
+Everything here is a no-op when ``mesh is None``: the kernels take
+``mesh`` as a *static* jit argument (``Mesh`` is hashable), so the
+default single-device path traces byte-identical jaxprs to the
+pre-sharding engine. All engine arithmetic is integer/boolean/modular
+uint32 — order-independent reductions — so sharded and unsharded runs
+must agree *bitwise*, which ``tests/test_sharding.py`` and
+``__graft_entry__.dryrun_multichip`` both assert.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: The one mesh axis name: the partitioned capacity ("slot") dimension.
+AXIS = "slots"
+
+
+def slot_mesh(n_devices: Optional[int] = None, devices=None):
+    """A 1-D mesh over ``devices`` (default: all), axis name ``AXIS``.
+
+    ``n_devices`` trims the device list (e.g. exactly 8 of a larger
+    host) and errors when fewer are available — callers that want
+    graceful degradation check ``len(jax.devices())`` first
+    (``__graft_entry__.dryrun_multichip``).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices for the slot mesh, have "
+                f"{len(devices)} — force more with XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n_devices} "
+                f"before importing jax")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def mesh_size(mesh) -> int:
+    """Number of devices along the slot axis."""
+    return int(mesh.shape[AXIS])
+
+
+def spec_for(shape: Sequence[int], capacity: int, mesh):
+    """The ``PartitionSpec`` for one leaf: shard the first capacity-sized
+    axis, replicate everything else.
+
+    The divisibility guard (SNIPPETS.md [3]) replicates any array whose
+    capacity axis does not divide the mesh — sharding would force uneven
+    padding and XLA reshards mid-step. Scalars, the ``[256, 8]`` scan
+    LUTs, and per-instance fallback tables never match and replicate.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = mesh_size(mesh)
+    for axis, dim in enumerate(shape):
+        if dim == capacity and capacity % n_dev == 0:
+            return P(*([None] * axis + [AXIS]))
+    return P()
+
+
+def sharding_for(x, capacity: int, mesh):
+    """The committed ``NamedSharding`` for one array leaf."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, spec_for(jnp.shape(x), capacity, mesh))
+
+
+def constrain(x, mesh, capacity: int):
+    """``with_sharding_constraint`` under ``spec_for``; identity when
+    ``mesh is None`` (the single-device path compiles the constraint
+    out — no jaxpr change at all)."""
+    if mesh is None:
+        return x
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, sharding_for(x, capacity, mesh))
+
+
+def replicate(x, mesh):
+    """Pin ``x`` fully replicated on ``mesh``; identity when ``mesh is
+    None``.
+
+    This is the escape hatch for block-carry temporaries whose tiny
+    leading dimension (e.g. ``C/8`` packed bytes) the partitioner would
+    otherwise spread over more devices than it has elements: XLA's SPMD
+    slice/concat handling on such over-partitioned arrays reads shard
+    *padding* (observed miscompile on the CPU backend — a ``x[:-1]``
+    of a ``[2]``-element carry returned pad garbage on an 8-way mesh).
+    Pinning the region replicated keeps those ops off the partitioner.
+    """
+    if mesh is None:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec()))
+
+
+def constrain_tree(tree, mesh, capacity: int):
+    """``constrain`` every array leaf of a pytree (states, logs,
+    schedules). Identity when ``mesh is None``."""
+    if mesh is None:
+        return tree
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: constrain(x, mesh, capacity), tree)
+
+
+def shard_put(tree, mesh, capacity: Optional[int] = None):
+    """``device_put`` a pytree with committed per-leaf shardings.
+
+    This is how inputs *enter* the mesh: committed shardings make GSPMD
+    propagate the layout through the jitted step instead of defaulting
+    to replication. ``capacity`` defaults to the first leaf's leading
+    dimension (the slot universe's ``C``).
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if capacity is None:
+        capacity = _infer_capacity(leaves)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding_for(x, capacity, mesh)), tree)
+
+
+def _infer_capacity(leaves) -> int:
+    """The slot-universe capacity: the largest leading dimension among
+    rank>=1 leaves (scalars carry no shape; ``[W, C]``/``[I, C]``
+    tensors have small leading dims)."""
+    import jax.numpy as jnp
+
+    dims = [d for leaf in leaves for d in jnp.shape(leaf)]
+    if not dims:
+        raise ValueError("cannot infer capacity from an all-scalar pytree")
+    return max(dims)
+
+
+def state_shardings(state, mesh):
+    """Per-leaf ``NamedSharding`` pytree for an ``EngineState`` (or any
+    slot-universe pytree) — usable as jit ``in_shardings``/
+    ``out_shardings`` or for documentation/introspection."""
+    import jax
+
+    capacity = int(state.member.shape[0])
+    return jax.tree_util.tree_map(
+        lambda x: sharding_for(x, capacity, mesh), state)

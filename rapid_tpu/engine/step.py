@@ -56,6 +56,7 @@ from rapid_tpu import hashing
 from rapid_tpu.engine import cut, invariants, monitor
 from rapid_tpu.engine import churn as churn_mod
 from rapid_tpu.engine import paxos as paxos_mod
+from rapid_tpu.engine import sharding as sharding_mod
 from rapid_tpu.engine import votes as votes_mod
 from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
                                     StepLog, config_id_limbs)
@@ -82,8 +83,17 @@ def reset_trace_count() -> None:
 
 
 def step(state: EngineState, faults: EngineFaults, settings: Settings,
-         churn=None, fallback=None) -> tuple:
-    """Advance the engine by one tick; returns (new_state, StepLog)."""
+         churn=None, fallback=None, mesh=None) -> tuple:
+    """Advance the engine by one tick; returns (new_state, StepLog).
+
+    ``mesh`` (static, default None) partitions the capacity axis of
+    every slot-universe array over a 1-D device mesh
+    (``rapid_tpu.engine.sharding``): the kernels re-commit the slot
+    sharding after their cross-slot stages and the returned state/log
+    are constrained so the ``lax.scan`` carry never reshards between
+    ticks. ``mesh=None`` compiles every constraint out — the
+    single-device jaxpr is unchanged.
+    """
     global _TRACE_COUNT
     _TRACE_COUNT += 1
 
@@ -95,9 +105,10 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     # ---- phase 0: classic-Paxos chain deliveries (earliest seq order) --
     if fallback is not None:
         state, px_counts, classic_decide, classic_pid = \
-            paxos_mod.chain_deliver(jnp, state, fallback, t, n_member)
+            paxos_mod.chain_deliver(jnp, state, fallback, t, n_member,
+                                    mesh=mesh)
         fast2_decide, win_pid, px_tally, px_quorum = paxos_mod.fast_tally(
-            jnp, state, fallback, t, n_member, classic_decide)
+            jnp, state, fallback, t, n_member, classic_decide, mesh=mesh)
         n_pids = fallback.table_mask.shape[1]
         sc_pid = jnp.clip(
             jnp.where(classic_decide, classic_pid, win_pid), 0, n_pids - 1)
@@ -116,7 +127,7 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         jnp,
         jnp.broadcast_to(state.phash_hi, (c,)),
         jnp.broadcast_to(state.phash_lo, (c,)),
-        valid, n_member)
+        valid, n_member, mesh=mesh)
     vote_tally = jnp.where(votes_arriving, tally, 0).astype(jnp.int32)
     vote_quorum = jnp.where(
         votes_arriving, votes_mod.fast_quorum(jnp, n_member), 0
@@ -151,7 +162,8 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         ihi, ilo = hashing.sum64(jnp, state.idfp_hi * jn, state.idfp_lo * jn)
         id_hi, id_lo = hashing.add64(
             jnp, state.idsum_hi, state.idsum_lo, ihi, ilo)
-        topo = build_topology(jnp, member, state.ring_order, state.ring_rank)
+        topo = build_topology(jnp, member, state.ring_order, state.ring_rank,
+                              mesh=mesh)
         pos = (paxos_mod.ring0_positions(jnp, member, state.ring_order,
                                          state.ring_rank)
                if fallback is not None else state.px_pos)
@@ -219,7 +231,7 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     # ---- phase 1b: late phase-1a delivery (task-phase send, last seq) --
     if fallback is not None:
         mid, px1b_counts = paxos_mod.phase1a_deliver(
-            jnp, mid, fallback, t, n_member, decide_now)
+            jnp, mid, fallback, t, n_member, decide_now, mesh=mesh)
         px_counts.update(px1b_counts)
 
     # ---- phase 2: alert delivery, aggregation, announce + vote cast ----
@@ -235,7 +247,8 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         delivered_up = churn_up
     (reports, seen_down, announce_now, crossed, _explicit_added,
      implicit_added) = cut.aggregate(
-        jnp, mid, delivered_down, delivered_up, n_alive > 0, settings)
+        jnp, mid, delivered_down, delivered_up, n_alive > 0, settings,
+        mesh=mesh)
 
     ph_hi, ph_lo = votes_mod.proposal_fingerprint(
         jnp, crossed, mid.uid_hi, mid.uid_lo)
@@ -300,7 +313,7 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     # ---- phase 4c: fallback task phase (proposes + timer fires) --------
     if fallback is not None:
         new_state, px_task_counts = paxos_mod.task_phase(
-            jnp, new_state, fallback, t, n_member_now, decide_now)
+            jnp, new_state, fallback, t, n_member_now, decide_now, mesh=mesh)
         px_counts.update(px_task_counts)
         px_timers_armed = (new_state.px_timer != I32_MAX).sum() \
             .astype(jnp.int32)
@@ -379,36 +392,58 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         px_coord_round=px_coord_round,
         inv_bits=inv_bits,
     )
+    # Pin the carry (and the scanned log's [C] columns) to the slot
+    # partition: without this the next tick would open with whatever
+    # layout the last cross-slot op left behind — a per-tick reshard.
+    new_state = sharding_mod.constrain_tree(new_state, mesh, c)
+    log = sharding_mod.constrain_tree(log, mesh, c)
     return new_state, log
 
 
-@partial(jax.jit, static_argnums=(2,))
+@partial(jax.jit, static_argnums=(2, 5))
 def engine_step(state: EngineState, faults: EngineFaults,
-                settings: Settings, churn=None, fallback=None) -> tuple:
-    """One jitted tick — a single device dispatch per call."""
-    return step(state, faults, settings, churn, fallback)
+                settings: Settings, churn=None, fallback=None,
+                mesh=None) -> tuple:
+    """One jitted tick — a single device dispatch per call.
+
+    ``mesh`` (static; a hashable ``jax.sharding.Mesh`` or None) shards
+    the tick over the slot axis — see ``rapid_tpu.engine.sharding``.
+    """
+    return step(state, faults, settings, churn, fallback, mesh)
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@partial(jax.jit, static_argnums=(2, 3, 6))
 def _simulate(state, faults, n_ticks: int, settings: Settings, churn=None,
-              fallback=None):
+              fallback=None, mesh=None):
+    # Commit the initial carry to the slot partition before the scan so
+    # tick 0 starts sharded instead of resharding on first use.
+    if mesh is not None:
+        c = state.member.shape[0]
+        state = sharding_mod.constrain_tree(state, mesh, c)
+        faults = sharding_mod.constrain_tree(faults, mesh, c)
+
     def body(carry, _):
-        return step(carry, faults, settings, churn, fallback)
+        return step(carry, faults, settings, churn, fallback, mesh)
 
     return lax.scan(body, state, None, length=n_ticks)
 
 
 def simulate(state: EngineState, faults: EngineFaults, n_ticks: int,
-             settings: Settings, churn=None, fallback=None) -> tuple:
+             settings: Settings, churn=None, fallback=None,
+             mesh=None) -> tuple:
     """Run ``n_ticks`` engine steps as one jitted ``lax.scan``.
 
     Returns (final_state, logs) where each ``logs`` field is stacked with
     a leading ``n_ticks`` axis. ``churn`` is an optional ``ChurnSchedule``
     (see ``rapid_tpu.engine.churn``) and ``fallback`` an optional
     ``FallbackSchedule`` (see ``rapid_tpu.engine.paxos``); None compiles
-    the respective subsystem out.
+    the respective subsystem out. ``mesh`` is an optional 1-D device mesh
+    (``rapid_tpu.engine.sharding.slot_mesh``): the scan carry stays
+    partitioned over the slot axis across all ticks, and results are
+    bit-identical to the unsharded run.
     """
-    return _simulate(state, faults, int(n_ticks), settings, churn, fallback)
+    return _simulate(state, faults, int(n_ticks), settings, churn, fallback,
+                     mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -430,7 +465,7 @@ def reset_fleet_trace_count() -> None:
 
 
 def fleet_body(states, faults, churn, fallback, n_ticks: int,
-               settings: Settings):
+               settings: Settings, mesh=None):
     """The un-jitted fleet computation: ``vmap(scan(step))``.
 
     Every argument is a pytree whose leaves carry a leading fleet axis
@@ -440,6 +475,11 @@ def fleet_body(states, faults, churn, fallback, n_ticks: int,
     mandatory here (fleet members use inert schedules rather than None)
     so all members share one treedef. Exposed un-jitted so tests can
     ``jax.make_jaxpr`` it and prove the jaxpr size is F-invariant.
+
+    ``mesh`` (static) composes with the fleet vmap: each member's slot
+    axis is partitioned while the fleet axis stays replicated — the
+    batched constraint lowers to ``P(None, 'slots')`` on ``[F, C]``
+    leaves, so a vmapped campaign shards exactly like a single member.
     """
     global _FLEET_TRACE_COUNT
     _FLEET_TRACE_COUNT += 1
@@ -447,14 +487,15 @@ def fleet_body(states, faults, churn, fallback, n_ticks: int,
     def one(state, member_faults, member_churn, member_fallback):
         def body(carry, _):
             return step(carry, member_faults, settings, member_churn,
-                        member_fallback)
+                        member_fallback, mesh)
 
         return lax.scan(body, state, None, length=n_ticks)
 
     return jax.vmap(one)(states, faults, churn, fallback)
 
 
-@partial(jax.jit, static_argnums=(4, 5))
+@partial(jax.jit, static_argnums=(4, 5, 6))
 def _fleet_simulate(states, faults, churn, fallback, n_ticks: int,
-                    settings: Settings):
-    return fleet_body(states, faults, churn, fallback, n_ticks, settings)
+                    settings: Settings, mesh=None):
+    return fleet_body(states, faults, churn, fallback, n_ticks, settings,
+                      mesh)

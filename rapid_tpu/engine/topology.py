@@ -38,6 +38,7 @@ from __future__ import annotations
 import numpy as np
 
 from rapid_tpu import hashing
+from rapid_tpu.engine import sharding as sharding_mod
 
 
 #: Block width of the member scans in ``_pred_succ_pos`` — pinned to 8:
@@ -79,7 +80,7 @@ def _scan_luts():
 _LUT_PRED, _LUT_SUCC, _LUT_LAST, _LUT_FIRST = _scan_luts()
 
 
-def _pred_succ_pos(xp, member_s, n):
+def _pred_succ_pos(xp, member_s, n, mesh=None):
     """Nearest-member ring positions from the mask in ring order.
 
     Returns ``(pgpos, succpos)``, i32 ``[C]``: for ring position ``p``,
@@ -96,6 +97,15 @@ def _pred_succ_pos(xp, member_s, n):
     are what bounds ``build_topology``'s FLOPs/bytes, no scatter and no
     prefix-sum compaction anywhere in the tick path (XLA's CPU scatter
     alone cost more wall clock than the whole kernel does now).
+
+    Under a device ``mesh``, the whole block scan is pinned *replicated*
+    (``sharding.replicate``): the ``[C/8]`` block carries are smaller
+    than the mesh, and letting the partitioner spread them produced a
+    miscompile (shard-padding garbage out of the ``bprev[:-1]`` slice on
+    the CPU backend). The scan is already global — its input is the
+    ring-ordered gather of the member mask — so replication costs one
+    small all-gather the kernel needed anyway; ``build_topology``
+    re-shards the final index arrays.
     """
     c = member_s.shape[0]
     if xp is np:
@@ -120,7 +130,9 @@ def _pred_succ_pos(xp, member_s, n):
     b = _SCAN_BLOCK
     # packbits zero-pads the last byte, and zero bits are non-members,
     # so no explicit padding is needed anywhere.
+    member_s = sharding_mod.replicate(member_s, mesh)
     packed = xp.packbits(member_s, bitorder="little")  # uint8 [ceil(C/8)]
+    packed = sharding_mod.replicate(packed, mesh)
     nb = packed.shape[0]
     base = xp.arange(nb, dtype=xp.int32) * b
     end = xp.int32(nb * b)  # past-the-end sentinel for the carries
@@ -138,8 +150,10 @@ def _pred_succ_pos(xp, member_s, n):
     lastf = xp.where(n > 0, bprev[-1], xp.int32(0))
     bprev_excl = xp.concatenate([xp.full(1, -1, xp.int32), bprev[:-1]])
     bprev_excl = xp.where(bprev_excl < 0, lastf, bprev_excl)
+    bprev_excl = sharding_mod.replicate(bprev_excl, mesh)
     pgpos = xp.where(loc >= 0, base[:, None] + loc.astype(xp.int32),
                      bprev_excl[:, None]).reshape(-1)[:c]
+    pgpos = sharding_mod.replicate(pgpos, mesh)
 
     # Backward mirror: first member strictly after, local sentinel B,
     # block carries wrapped to the first member (padding bits are
@@ -153,8 +167,10 @@ def _pred_succ_pos(xp, member_s, n):
     firstf = xp.where(n > 0, bnext[0], xp.int32(0))
     bnext_excl = xp.concatenate([bnext[1:], end[None]])
     bnext_excl = xp.where(bnext_excl >= c, firstf, bnext_excl)
+    bnext_excl = sharding_mod.replicate(bnext_excl, mesh)
     succpos = xp.where(sloc < b, base[:, None] + sloc.astype(xp.int32),
                        bnext_excl[:, None]).reshape(-1)[:c]
+    succpos = sharding_mod.replicate(succpos, mesh)
     return pgpos, succpos
 
 
@@ -221,9 +237,16 @@ def rank_and_insert(xp, slot, uid_hi, uid_lo, ring_order, ring_rank):
     return xp.stack(new_orders, axis=1), xp.stack(new_ranks, axis=1)
 
 
-def build_topology(xp, member, ring_order, ring_rank):
+def build_topology(xp, member, ring_order, ring_rank, mesh=None):
     """Compute (subj_idx, obs_idx, gk_idx, fd_active, fd_first), each ``[C, K]``,
     from the static per-ring order — no sort traced.
+
+    ``mesh`` (static) re-commits the slot sharding on every output: the
+    per-ring nearest-member scans gather through the global ring
+    permutation (inherently cross-slot), so the constraint is what
+    brings the rebuilt ``[C, K]`` index arrays back to the partitioned
+    layout the rest of the tick consumes. ``mesh=None`` (and the host
+    ``xp=np`` path) compiles to the identical kernel as before.
 
     - ``subj_idx[n, j]``: slot of node n's ring-j subject (predecessor);
     - ``obs_idx[n, j]``: slot of node n's ring-j observer (successor);
@@ -260,7 +283,7 @@ def build_topology(xp, member, ring_order, ring_rank):
         # gathering through each slot's own ring position and then
         # through the order — one shared [2, C] gather pair for both
         # neighbour columns.
-        pgpos, succpos = _pred_succ_pos(xp, member_s, n)
+        pgpos, succpos = _pred_succ_pos(xp, member_s, n, mesh=mesh)
         if xp is np:
             pg = order[pgpos[rank]]
             succ = order[succpos[rank]]
@@ -290,4 +313,8 @@ def build_topology(xp, member, ring_order, ring_rank):
         fd_active_cols.append((first == j) & usable)
     fd_first = xp.stack(fd_first_cols, axis=1)
     fd_active = xp.stack(fd_active_cols, axis=1)
+    if mesh is not None and xp is not np:
+        con = lambda a: sharding_mod.constrain(a, mesh, c)
+        subj_idx, obs_idx, gk_idx, fd_active, fd_first = map(
+            con, (subj_idx, obs_idx, gk_idx, fd_active, fd_first))
     return subj_idx, obs_idx, gk_idx, fd_active, fd_first

@@ -18,6 +18,7 @@ from __future__ import annotations
 import jax
 
 from rapid_tpu import hashing
+from rapid_tpu.engine import sharding
 
 
 def proposal_fingerprint(xp, proposal_mask, uid_hi, uid_lo):
@@ -33,11 +34,17 @@ def proposal_fingerprint(xp, proposal_mask, uid_hi, uid_lo):
     return hashing.splitmix64_limbs(xp, shi, slo)
 
 
-def segmented_vote_count(xp, vote_hi, vote_lo, valid):
+def segmented_vote_count(xp, vote_hi, vote_lo, valid, mesh=None):
     """i32 [C]: for each slot, the number of valid votes equal to its vote.
 
     Invalid slots count 0. Ties are grouped by sorting on (valid, hi, lo)
     and summing run lengths with ``segment_sum``.
+
+    ``mesh`` (static) re-commits the slot sharding on the scattered
+    output: the lexsort itself is a global all-gather (sorting is the
+    one cross-slot stage of the tally), but the constraint stops the
+    replicated layout from leaking into the consumers — the per-slot
+    count vector re-partitions before the quorum reductions.
     """
     c = vote_hi.shape[0]
     invalid = (~valid).astype(xp.uint32)
@@ -51,7 +58,8 @@ def segmented_vote_count(xp, vote_hi, vote_lo, valid):
     seg_counts = jax.ops.segment_sum(sval.astype(xp.int32), seg_id,
                                      num_segments=c)
     counts_sorted = seg_counts[seg_id] * sval.astype(xp.int32)
-    return xp.zeros((c,), xp.int32).at[order].set(counts_sorted)
+    out = xp.zeros((c,), xp.int32).at[order].set(counts_sorted)
+    return sharding.constrain(out, mesh, c)
 
 
 def fast_quorum(xp, n_member):
@@ -65,14 +73,16 @@ def fast_quorum(xp, n_member):
     return (n_member - (n_member - 1) // 4).astype(xp.int32)
 
 
-def count_fast_round(xp, vote_hi, vote_lo, valid, n_member):
+def count_fast_round(xp, vote_hi, vote_lo, valid, n_member, mesh=None):
     """Returns (decided, winner_count): quorum check over delivered votes.
 
     ``valid[n]`` marks a delivered vote from slot n; a decision needs both
     the total delivered votes and some single value's count at quorum.
+    ``mesh`` (static) keeps the per-slot tally partitioned — see
+    ``segmented_vote_count``.
     """
     quorum = fast_quorum(xp, n_member)
-    per_vote = segmented_vote_count(xp, vote_hi, vote_lo, valid)
+    per_vote = segmented_vote_count(xp, vote_hi, vote_lo, valid, mesh=mesh)
     winner_count = per_vote.max()
     total = valid.sum().astype(xp.int32)
     return (total >= quorum) & (winner_count >= quorum), winner_count

@@ -110,12 +110,16 @@ def synthetic_state(n: int, settings, seed: int = 0,
     return state, faults
 
 
-def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
+def kernel_cases(state, faults, settings, fallback=None,
+                 mesh=None) -> List[Tuple]:
     """(name, fn, args) for each separately-lowered sub-kernel.
 
     The closures mirror the call sites in ``engine/step.py`` exactly
     (same operand shapes, same derived scalars), so the per-kernel costs
-    add up to the composed step's profile.
+    add up to the composed step's profile. ``mesh`` (static) profiles
+    the slot-sharded variants — pass sharded ``state``/``faults``
+    (``sharding.shard_put``) so the committed input layouts match the
+    constraints the kernels re-assert.
     """
     import jax.numpy as jnp
 
@@ -126,7 +130,7 @@ def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
     from rapid_tpu.engine.topology import build_topology
 
     def topology_rebuild(member, ring_order, ring_rank):
-        return build_topology(jnp, member, ring_order, ring_rank)
+        return build_topology(jnp, member, ring_order, ring_rank, mesh=mesh)
 
     def monitor_kernel(state, faults):
         return monitor.monitor_tick(jnp, state, faults, settings)
@@ -138,7 +142,7 @@ def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
         delivered_up = jnp.zeros_like(delivered_down)
         any_recv = (state.member & ~crashed).any()
         return cut.aggregate(jnp, state, delivered_down, delivered_up,
-                             any_recv, settings)
+                             any_recv, settings, mesh=mesh)
 
     def vote_count(state, faults):
         crashed = monitor.crashed_at(faults, state.tick + 1)
@@ -149,7 +153,7 @@ def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
             jnp,
             jnp.broadcast_to(state.phash_hi, (c,)),
             jnp.broadcast_to(state.phash_lo, (c,)),
-            valid, n_member)
+            valid, n_member, mesh=mesh)
 
     cases = [
         ("topology_rebuild", topology_rebuild,
@@ -165,23 +169,24 @@ def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
         def paxos_chain_deliver(state, sched):
             n_member = state.member.sum().astype(jnp.int32)
             return paxos_mod.chain_deliver(jnp, state, sched,
-                                           state.tick + 1, n_member)
+                                           state.tick + 1, n_member,
+                                           mesh=mesh)
 
         def paxos_fast_tally(state, sched):
             n_member = state.member.sum().astype(jnp.int32)
             return paxos_mod.fast_tally(jnp, state, sched, state.tick + 1,
-                                        n_member, false_)
+                                        n_member, false_, mesh=mesh)
 
         def paxos_phase1a_deliver(state, sched):
             n_member = state.member.sum().astype(jnp.int32)
             return paxos_mod.phase1a_deliver(jnp, state, sched,
                                              state.tick + 1, n_member,
-                                             false_)
+                                             false_, mesh=mesh)
 
         def paxos_task_phase(state, sched):
             n_member = state.member.sum().astype(jnp.int32)
             return paxos_mod.task_phase(jnp, state, sched, state.tick + 1,
-                                        n_member, false_)
+                                        n_member, false_, mesh=mesh)
 
         cases += [
             ("paxos_chain_deliver", paxos_chain_deliver, (state, fallback)),
@@ -192,12 +197,12 @@ def kernel_cases(state, faults, settings, fallback=None) -> List[Tuple]:
         ]
 
         def full_step(state, faults, sched):
-            return step_fn(state, faults, settings, None, sched)
+            return step_fn(state, faults, settings, None, sched, mesh)
 
         cases.append(("full_step", full_step, (state, faults, fallback)))
     else:
         def full_step(state, faults):
-            return step_fn(state, faults, settings)
+            return step_fn(state, faults, settings, mesh=mesh)
 
         cases.append(("full_step", full_step, (state, faults)))
     return cases
@@ -300,11 +305,85 @@ def profile_kernels(n: int, settings, repeats: int = 5, seed: int = 0,
     }
 
 
+#: Kernels the multichip block compares sharded vs single-device — the
+#: two the dominance report names as the scaling bottlenecks
+#: (``cut_aggregate`` tops FLOPs/bytes everywhere, ``vote_count`` tops
+#: wall clock at 10k/100k) plus the composed step.
+MULTICHIP_KERNELS = ("cut_aggregate", "vote_count", "full_step")
+
+
+def multichip_comparison(sizes: Sequence[int], settings,
+                         n_devices: int = 8, repeats: int = 5,
+                         seed: int = 0,
+                         warmup_ticks: int = 8) -> Optional[Dict[str, object]]:
+    """Sharded-vs-single-device wall clock for the dominant kernels.
+
+    Profiles ``MULTICHIP_KERNELS`` twice per size — once single-device,
+    once with inputs ``shard_put`` on an ``n_devices``-way slot mesh and
+    the mesh threaded through the kernel — and reports both medians plus
+    the speedup ratio. Returns ``None`` when the process has fewer than
+    ``n_devices`` devices (the artifact records the absence rather than
+    crashing; force devices with ``xla_force_host_platform_device_count``
+    before importing jax). Sizes whose capacity does not divide the mesh
+    are skipped: the sharder would replicate them anyway.
+
+    Both sides of the comparison run in the *same* process, so they see
+    the same thread budget — but note the forced-device override itself
+    splits the host CPU's thread pool across the virtual devices, which
+    depresses absolute wall medians relative to a clean single-device
+    process (hence the ``--merge-multichip`` two-process recipe for the
+    committed artifact).
+    """
+    import jax
+
+    if len(jax.devices()) < n_devices:
+        return None
+
+    from rapid_tpu.engine import sharding
+
+    mesh = sharding.slot_mesh(n_devices)
+    entries: List[Dict[str, object]] = []
+    for n in sizes:
+        state, faults = synthetic_state(n, settings, seed=seed,
+                                        warmup_ticks=warmup_ticks)
+        c = int(state.member.shape[0])
+        if c % n_devices:
+            continue
+        plain = {name: (fn, args) for name, fn, args
+                 in kernel_cases(state, faults, settings)}
+        s_state = sharding.shard_put(state, mesh, c)
+        s_faults = sharding.shard_put(faults, mesh, c)
+        sharded = {name: (fn, args) for name, fn, args
+                   in kernel_cases(s_state, s_faults, settings, mesh=mesh)}
+        for kname in MULTICHIP_KERNELS:
+            base = measure_kernel(kname, *plain[kname], repeats=repeats)
+            shrd = measure_kernel(kname, *sharded[kname], repeats=repeats)
+            entries.append({
+                "kernel": kname,
+                "n": n,
+                "single_wall_median_s": base.wall_median_s,
+                "sharded_wall_median_s": shrd.wall_median_s,
+                "speedup": round(
+                    base.wall_median_s / shrd.wall_median_s, 3)
+                if shrd.wall_median_s else None,
+            })
+    return {"n_devices": n_devices, "axis": sharding.AXIS,
+            "kernels": entries}
+
+
 def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
                      seed: int = 0, warmup_ticks: int = 8,
-                     include_fallback: bool = True) -> Dict[str, object]:
+                     include_fallback: bool = True,
+                     multichip: bool = True,
+                     multichip_devices: int = 8) -> Dict[str, object]:
     """The ``--profile-sweep`` artifact: per-N kernel costs plus the
-    wall-clock-dominant kernel per N (the pjit-sharding gate input)."""
+    wall-clock-dominant kernel per N (the pjit-sharding gate input).
+
+    When ``multichip`` is on and enough devices exist, the payload also
+    carries a ``multichip`` block with sharded-vs-single-device wall
+    medians for the dominant kernels; otherwise the key is ``null`` so
+    consumers can tell "not measured" from "not present".
+    """
     import jax
 
     runs = [profile_kernels(n, settings, repeats=repeats, seed=seed,
@@ -320,6 +399,9 @@ def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
         "runs": runs,
         "dominant_by_n": {str(r["n"]): r["dominant"]["wall_clock"]
                           for r in runs},
+        "multichip": multichip_comparison(
+            sizes, settings, n_devices=multichip_devices, repeats=repeats,
+            seed=seed, warmup_ticks=warmup_ticks) if multichip else None,
     }
 
 
@@ -336,6 +418,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "profiled state (default 8)")
     parser.add_argument("--no-fallback", action="store_true",
                         help="skip the classic-Paxos phase kernels")
+    parser.add_argument("--no-multichip", action="store_true",
+                        help="skip the sharded-vs-single-device block")
+    parser.add_argument("--multichip-devices", type=int, default=8,
+                        help="mesh width for the multichip block "
+                             "(default 8; needs that many jax devices)")
+    parser.add_argument("--merge-multichip", type=str, default=None,
+                        metavar="REPORT",
+                        help="take the multichip block from an existing "
+                             "report instead of measuring it here. Forcing "
+                             "xla_force_host_platform_device_count splits "
+                             "the CPU thread pool across the virtual "
+                             "devices and depresses every single-device "
+                             "wall median, so the committed artifact is "
+                             "built in two processes: the main sweep in a "
+                             "clean env, the multichip block under the "
+                             "forced mesh, merged with this flag")
     parser.add_argument("--out", type=str, default=None,
                         help="write the report JSON to FILE "
                              "(default: stdout)")
@@ -346,7 +444,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     report = dominance_report(args.sizes, Settings(), repeats=args.repeats,
                               seed=args.seed,
                               warmup_ticks=args.warmup_ticks,
-                              include_fallback=not args.no_fallback)
+                              include_fallback=not args.no_fallback,
+                              multichip=(not args.no_multichip
+                                         and args.merge_multichip is None),
+                              multichip_devices=args.multichip_devices)
+    if args.merge_multichip is not None:
+        with open(args.merge_multichip) as fh:
+            report["multichip"] = json.load(fh).get("multichip")
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(json.dumps(report, indent=2) + "\n")

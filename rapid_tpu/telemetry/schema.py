@@ -129,6 +129,24 @@ PROFILE_SWEEP_SPEC = {
     "dominant_by_n": (dict,),
 }
 
+#: Sharded-vs-single-device block of the dominance report
+#: (``rapid_tpu.telemetry.profile.multichip_comparison``). The top-level
+#: ``multichip`` key may be ``null`` (not enough devices at profile
+#: time); when present it must carry these fields.
+MULTICHIP_SPEC = {
+    "n_devices": (int,),
+    "axis": (str,),
+    "kernels": (list,),
+}
+
+MULTICHIP_ENTRY_SPEC = {
+    "kernel": (str,),
+    "n": (int,),
+    "single_wall_median_s": _NUM,
+    "sharded_wall_median_s": _NUM,
+    "speedup": (int, float, type(None)),
+}
+
 
 #: Fleet-campaign block embedded in a fleet run payload under
 #: ``"campaign"`` (``rapid_tpu.campaign.run_campaign``).
@@ -255,6 +273,13 @@ def validate_profile_payload(payload, where: str = "payload") -> List[str]:
             if not isinstance(kernel, str):
                 errors.append(f"{where}.dominant_by_n[{n}]: expected str, "
                               f"got {type(kernel).__name__}")
+    mc = payload.get("multichip")
+    if mc is not None:  # null means "not measured", which is valid
+        errors += _check(mc, MULTICHIP_SPEC, f"{where}.multichip")
+        if isinstance(mc, dict):
+            for j, entry in enumerate(mc.get("kernels") or []):
+                errors += _check(entry, MULTICHIP_ENTRY_SPEC,
+                                 f"{where}.multichip.kernels[{j}]")
     return errors
 
 
@@ -301,9 +326,14 @@ def main(argv=None) -> int:
         print("usage: python -m rapid_tpu.telemetry.schema BENCH_JSON",
               file=sys.stderr)
         return 2
-    with open(argv[0]) as fh:
-        payload = json.load(fh)
-    errors = validate_bench_payload(payload)
+    with open(argv[0], "rb") as fh:
+        raw = fh.read()
+    # Every JSON artifact is a line-oriented build product: tools that
+    # append or concatenate them rely on the trailing newline.
+    errors = [] if raw.endswith(b"\n") else \
+        ["payload: file must end with a trailing newline"]
+    payload = json.loads(raw)
+    errors += validate_bench_payload(payload)
     if errors:
         for e in errors:
             print(f"schema violation: {e}", file=sys.stderr)

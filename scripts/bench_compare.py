@@ -167,6 +167,21 @@ def compare_profile_sweeps(current: Dict, baseline: Dict,
                     f"{where}.{name}.wall_median_s: {cur_w:.3e} is "
                     f"{up:.0f}% above baseline {base_w:.3e} (tolerance "
                     f"{wall_tolerance * 100:.0f}%)")
+
+    # Multichip block: wall numbers are machine-dependent (warn-only via
+    # the schema's structural check); only the mesh *shape* is config. A
+    # null/absent block on either side is fine — the tier-1 profile
+    # smoke runs without forced devices and records null, while the
+    # committed sweep carries real numbers.
+    cur_mc = current.get("multichip")
+    base_mc = baseline.get("multichip")
+    if isinstance(cur_mc, dict) and isinstance(base_mc, dict):
+        for key in ("n_devices", "axis"):
+            if cur_mc.get(key) != base_mc.get(key):
+                errors.append(
+                    f"payload.multichip.{key}: config mismatch (current "
+                    f"{cur_mc.get(key)!r} vs baseline {base_mc.get(key)!r})"
+                    f" — regenerate with --update-baseline")
     return errors, warnings
 
 

@@ -105,4 +105,23 @@ if [ "$rc" -eq 0 ]; then
         rc=1
     fi
 fi
+
+# Multi-chip smoke: the dry-run entrypoint must boot BASELINE config #1
+# on the forced 8-device CPU mesh, run the sharded tick loop, and print
+# a parseable result line with ok=true (three-way bit-identity: sharded
+# == single-device == oracle). The entrypoint forces the host-platform
+# override itself, so no XLA_FLAGS are needed here.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m __graft_entry__ \
+            > /tmp/_t1_multichip.out \
+        && tail -n 1 /tmp/_t1_multichip.out | python -c '
+import json, sys
+line = json.loads(sys.stdin.read())
+sys.exit(0 if line.get("ok") is True else 1)'; then
+        echo MULTICHIP_SMOKE=ok
+    else
+        echo MULTICHIP_SMOKE=failed
+        rc=1
+    fi
+fi
 exit $rc

@@ -16,13 +16,30 @@ campaign distributions (ticks-to-decide percentiles, message-complexity
 tails, invariant-violation rates) are nearest-rank percentiles over the
 per-member summaries — bit-deterministic in the campaign seed.
 
-Exactness: a seeded subset of members (≥1 partition and ≥1 contested /
-classic-fallback scenario when the check budget allows) is replayed
-host-side through ``diff.run_adversarial_differential``, the per-slot
-oracle referee. Churn-mix members are excluded from the spot-check pool
-— the referee replays ``AdversarySchedule`` surfaces only; churn
-scheduling stays engine-side (see ``engine.churn``). This referee loop
-is the only host-side part of a campaign.
+Exactness: partition and flip-flop members are dispatched in
+**per-receiver** mode (``engine.receiver`` via
+``fleet.lower_receiver_schedule`` / ``receiver_fleet_simulate``), so
+their reported event streams and counters are *device-exact* under link
+faults — no host replay is load-bearing for them. Crash / contested /
+churn members keep the shared-state fast path, which is exact for those
+kinds. The quadratic per-receiver state is budgeted up front
+(``fleet.check_receiver_budget``): an oversized fleet raises a
+structured ``ReceiverBudgetError`` naming the measured per-member bytes
+before any device allocation, never an OOM mid-campaign.
+
+Spot checks are belt-and-suspenders on top of that: a seeded subset of
+members (≥1 partition and ≥1 contested / classic-fallback scenario when
+the check budget allows) is replayed host-side through the per-slot
+oracle referee — ``diff.run_receiver_differential`` for per-receiver
+kinds, ``diff.run_adversarial_differential`` for the rest. Churn-mix
+members are excluded from the spot-check pool (the referee replays
+``AdversarySchedule`` surfaces only; churn scheduling stays
+engine-side, see ``engine.churn``). A diverging check no longer kills
+the campaign outright: each failure writes a JSONL forensics artifact
+and lands as a structured record in the payload, and the run aborts
+only when failures exceed ``--max-spot-failures`` (default 0 keeps the
+old strictness). This referee loop is the only host-side part of a
+campaign.
 
 CLI::
 
@@ -34,8 +51,10 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import random
 import sys
+import tempfile
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -66,6 +85,28 @@ class CampaignConfig:
     weights: Optional[ScenarioWeights] = None
     spot_checks: int = 0
     settings: Optional[Settings] = None
+    # Route partition/flip-flop members through the per-receiver engine
+    # (device-exact under link faults); False forces every member onto
+    # the shared-state fast path (pre-exactness behaviour, cheap).
+    per_receiver: bool = True
+    # Spot-check failures tolerated before the campaign aborts; each
+    # failure writes a forensics artifact and a payload record either
+    # way. 0 == any divergence is fatal (the historical contract).
+    max_spot_failures: int = 0
+    # Where divergence artifacts land (default: the system temp dir).
+    artifact_dir: Optional[str] = None
+
+
+def _receiver_eligible(sc: SampledScenario) -> bool:
+    """Per-receiver dispatch eligibility: link-fault-only members.
+
+    Scripted proposes and churn are shared-path features (the
+    per-receiver envelope is crash + link windows, see
+    ``engine.receiver``); crash-only members gain nothing from the
+    quadratic state and stay on the fast path too.
+    """
+    return (sc.kind in ("partition", "flip_flop")
+            and not sc.wants_churn and not sc.schedule.proposes)
 
 
 def _member_seed(cfg: CampaignConfig, idx: int) -> int:
@@ -73,14 +114,20 @@ def _member_seed(cfg: CampaignConfig, idx: int) -> int:
     return hashing.hash64(idx, seed=cfg.seed & hashing.MASK64) & 0x7FFFFFFF
 
 
-def _sample_member(cfg: CampaignConfig, settings: Settings, idx: int):
-    """Draw member ``idx``'s scenario and lower it to the device."""
+def _sample_scenario(cfg: CampaignConfig, idx: int) -> SampledScenario:
+    """Draw member ``idx``'s scenario (seeded by the campaign seed)."""
+    return sample_adversary_schedule(cfg.n, _member_seed(cfg, idx),
+                                     cfg.ticks,
+                                     cfg.weights or DEFAULT_SCENARIO_WEIGHTS)
+
+
+def _lower_shared(cfg: CampaignConfig, settings: Settings, idx: int,
+                  sc: SampledScenario):
+    """Lower one shared-state member (the pre-existing fast path)."""
     from rapid_tpu.engine import churn as churn_mod
     from rapid_tpu.engine.fleet import lower_schedule
 
     seed = _member_seed(cfg, idx)
-    sc = sample_adversary_schedule(cfg.n, seed, cfg.ticks,
-                                   cfg.weights or DEFAULT_SCENARIO_WEIGHTS)
     churn = id_fps = None
     if sc.wants_churn and cfg.headroom >= 2:
         rng = random.Random(seed ^ 0xC4B0)
@@ -88,27 +135,43 @@ def _sample_member(cfg: CampaignConfig, settings: Settings, idx: int):
         churn, id_fps, _ = churn_mod.synthetic_churn_schedule(
             cfg.n + cfg.headroom, cfg.n, settings,
             start=rng.randint(5, 25), burst=burst)
-    member = lower_schedule(sc.schedule, settings, churn=churn,
-                            id_fps=id_fps)
-    return member, sc
+    return lower_schedule(sc.schedule, settings, churn=churn,
+                          id_fps=id_fps)
+
+
+def _chunks(seq: List[int], size: int) -> List[List[int]]:
+    return [seq[i:i + size] for i in range(0, len(seq), size)]
 
 
 def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
                 referee_settings: Settings) -> Dict[str, object]:
     """Replay a seeded member subset through the host oracle referee.
 
-    ``run_adversarial_differential`` raises (with forensics) on any
-    per-slot divergence, so a campaign either reports every check passed
-    or dies loudly. Members whose scenario wants churn are ineligible
-    (the referee replays fault surfaces only); if a required kind is
-    missing from the eligible pool, a fresh forced scenario of that kind
-    is synthesized from the campaign seed and checked as member ``-1``.
+    Per-receiver-eligible kinds replay through
+    ``run_receiver_differential`` (the same engine that ran the member
+    on device — belt-and-suspenders on the device-exact claim); the
+    rest through ``run_adversarial_differential``. Members whose
+    scenario wants churn are ineligible (the referee replays fault
+    surfaces only); if a required kind is missing from the eligible
+    pool, a fresh forced scenario of that kind is synthesized from the
+    campaign seed and checked as member ``-1``.
+
+    A divergence no longer dies in place: the failing check writes a
+    JSONL forensics artifact, lands as a structured member record
+    (``passed=False`` + error + artifact path), and the campaign aborts
+    only once failures exceed ``cfg.max_spot_failures`` — whose default
+    of 0 preserves the historical any-divergence-is-fatal contract.
     """
-    from rapid_tpu.engine.diff import run_adversarial_differential
+    from rapid_tpu.engine.diff import (run_adversarial_differential,
+                                       run_receiver_differential)
+    from rapid_tpu.engine.receiver import ReceiverEnvelopeError
+    from rapid_tpu.telemetry.forensics import DivergenceError
 
     requested = cfg.spot_checks
     block: Dict[str, object] = {"requested": requested, "run": 0,
-                                "passed": 0, "members": []}
+                                "passed": 0, "failed": 0,
+                                "max_failures": cfg.max_spot_failures,
+                                "members": []}
     if requested <= 0:
         return block
     rng = random.Random(cfg.seed ^ 0x5EED)
@@ -138,19 +201,45 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
     for i in rest[:max(0, requested - len(chosen))]:
         chosen.append((i, scenarios[i]))
 
+    art_dir = cfg.artifact_dir or tempfile.gettempdir()
     for idx, sc in chosen:
-        result = run_adversarial_differential(sc.schedule, cfg.ticks,
-                                              referee_settings)
-        result.assert_identical()
+        per_rx = cfg.per_receiver and _receiver_eligible(sc)
+        runner = run_receiver_differential if per_rx \
+            else run_adversarial_differential
+        artifact = os.path.join(
+            art_dir, f"rapid_tpu_spot_m{idx}_{sc.kind}_"
+                     f"{sc.schedule.seed}.jsonl")
+        record: Dict[str, object] = {
+            "member": idx, "kind": sc.kind, "seed": sc.schedule.seed,
+            "mode": "per_receiver" if per_rx else "shared",
+            "passed": True, "artifact": None, "error": None}
         block["run"] += 1
-        block["passed"] += 1
-        block["members"].append({"member": idx, "kind": sc.kind,
-                                 "seed": sc.schedule.seed})
+        try:
+            result = runner(sc.schedule, cfg.ticks, referee_settings)
+            result.assert_identical(artifact=artifact)
+            block["passed"] += 1
+        except (DivergenceError, ReceiverEnvelopeError) as err:
+            record["passed"] = False
+            record["artifact"] = artifact if os.path.exists(artifact) \
+                else None
+            record["error"] = str(err).splitlines()[0]
+            block["failed"] += 1
+        block["members"].append(record)
+    if block["failed"] > cfg.max_spot_failures:
+        bad = [m for m in block["members"] if not m["passed"]]
+        raise RuntimeError(
+            f"{block['failed']} spot-check divergence(s) exceed "
+            f"--max-spot-failures={cfg.max_spot_failures}: "
+            + "; ".join(
+                f"member {m['member']} ({m['kind']}, seed {m['seed']}): "
+                f"{m['error']}" + (f" [forensics: {m['artifact']}]"
+                                   if m["artifact"] else "")
+                for m in bad))
     return block
 
 
 def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
-    """Run one campaign; returns a schema-v3 bench run payload.
+    """Run one campaign; returns a schema-v4 bench run payload.
 
     The payload validates as an ``engine_tick`` run (``telemetry`` is the
     fleet-merged ``RunSummary``) and additionally carries the
@@ -161,9 +250,16 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
     """
     import jax
 
-    from rapid_tpu.engine.fleet import fleet_simulate, stack_members
+    from rapid_tpu.engine import receiver as receiver_mod
+    from rapid_tpu.engine.fleet import (check_receiver_budget,
+                                        fleet_simulate,
+                                        lower_receiver_schedule,
+                                        receiver_fleet_simulate,
+                                        stack_members,
+                                        stack_receiver_members)
     from rapid_tpu.telemetry.metrics import (fleet_summaries,
                                              merge_summaries,
+                                             summarize,
                                              summary_distributions)
     from rapid_tpu.telemetry.schema import SCHEMA_VERSION
 
@@ -171,25 +267,68 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
     c = cfg.n + cfg.headroom
     settings = base if base.capacity == c else base.with_(capacity=c)
     referee_settings = base if base.capacity == 0 else base.with_(capacity=0)
+    # Per-receiver members never churn, so they boot without the churn
+    # headroom — the quadratic state is sized to N, not N + headroom.
+    rx_settings = base if base.capacity == cfg.n \
+        else base.with_(capacity=cfg.n)
     f = max(1, cfg.fleet_size)
     dispatches = -(-cfg.clusters // f)
     total = dispatches * f
 
     t0 = time.perf_counter()
-    sampled = [_sample_member(cfg, settings, i) for i in range(total)]
-    scenarios = [sc for _, sc in sampled]
+    scenarios = [_sample_scenario(cfg, i) for i in range(total)]
+    rx_idx = [i for i, sc in enumerate(scenarios)
+              if cfg.per_receiver and _receiver_eligible(sc)]
+    sh_idx = [i for i in range(total) if i not in set(rx_idx)]
+    # Budget refusal first: an oversized per-receiver fleet raises the
+    # structured ReceiverBudgetError before any member is lowered.
+    fr = min(f, len(rx_idx)) if rx_idx else 0
+    if rx_idx:
+        check_receiver_budget(max(rx_settings.capacity, cfg.n), fr,
+                              rx_settings)
+    sh_members = {i: _lower_shared(cfg, settings, i, scenarios[i])
+                  for i in sh_idx}
+    rx_members = {i: lower_receiver_schedule(scenarios[i].schedule,
+                                             rx_settings, fleet_size=fr)
+                  for i in rx_idx}
     boot_s = time.perf_counter() - t0
 
     summaries = []
+    rx_dispatches = 0
     t0 = time.perf_counter()
     fold_s = 0.0
-    for d in range(dispatches):
-        fleet = stack_members([m for m, _ in
-                               sampled[d * f:(d + 1) * f]])
+    fs = min(f, len(sh_idx)) if sh_idx else 0
+    for chunk in _chunks(sh_idx, fs) if fs else []:
+        # Pad a trailing partial chunk by cycling its own members so
+        # every shared dispatch keeps one batched program shape; padded
+        # summaries are dropped below.
+        padded = chunk + [chunk[i % len(chunk)]
+                          for i in range(fs - len(chunk))]
+        fleet = stack_members([sh_members[i] for i in padded])
         finals, logs = fleet_simulate(fleet, cfg.ticks, settings)
         jax.block_until_ready(finals)
         tf = time.perf_counter()
-        summaries += fleet_summaries(logs)
+        summaries += fleet_summaries(logs)[:len(chunk)]
+        fold_s += time.perf_counter() - tf
+    for chunk in _chunks(rx_idx, fr) if fr else []:
+        padded = chunk + [chunk[i % len(chunk)]
+                          for i in range(fr - len(chunk))]
+        fleet = stack_receiver_members([rx_members[i] for i in padded])
+        finals, logs = receiver_fleet_simulate(fleet, cfg.ticks,
+                                               rx_settings)
+        jax.block_until_ready(finals)
+        rx_dispatches += 1
+        tf = time.perf_counter()
+        for j in range(len(chunk)):
+            mrs = jax.tree_util.tree_map(lambda x, j=j: x[j], finals)
+            mlog = jax.tree_util.tree_map(lambda x, j=j: x[j], logs)
+            # A nonzero envelope flag would void the device-exact claim
+            # for this member; eligibility keeps schedules inside the
+            # envelope, so this raising means an engine bug.
+            receiver_mod.check_flags(mrs.flags)
+            run = receiver_mod.receiver_run_payload(mrs, mlog, cfg.n,
+                                                    cfg.ticks)
+            summaries.append(summarize(run.metrics()))
         fold_s += time.perf_counter() - tf
     wall_s = time.perf_counter() - t0 - fold_s
 
@@ -202,6 +341,23 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
     t0 = time.perf_counter()
     spot = _spot_check(cfg, scenarios, referee_settings)
     spot_s = time.perf_counter() - t0
+
+    rx_kinds: Dict[str, int] = {}
+    for i in rx_idx:
+        k = scenarios[i].kind
+        rx_kinds[k] = rx_kinds.get(k, 0) + 1
+    rx_capacity = max(rx_settings.capacity, cfg.n)
+    per_receiver = {
+        "enabled": cfg.per_receiver,
+        "members": len(rx_idx),
+        "dispatches": rx_dispatches,
+        "fleet_size": fr,
+        "capacity": rx_capacity,
+        "capacity_cap": base.receiver_capacity_cap,
+        "member_state_bytes": receiver_mod.receiver_state_bytes(
+            rx_capacity, base.K),
+        "kinds": dict(sorted(rx_kinds.items())),
+    }
 
     return {
         "bench": "engine_tick",
@@ -230,6 +386,7 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
             "fleet_size": f,
             "dispatches": dispatches,
             "scenario_kinds": dict(sorted(kinds.items())),
+            "per_receiver": per_receiver,
             "spot_checks": spot,
             "distributions": dists,
         },
@@ -265,7 +422,21 @@ def main(argv=None) -> int:
                         help="dormant slots per cluster for churn joins")
     parser.add_argument("--spot-checks", type=int, default=0,
                         help="members replayed through the host oracle "
-                             "referee (run_adversarial_differential)")
+                             "referee (run_adversarial_differential / "
+                             "run_receiver_differential)")
+    parser.add_argument("--max-spot-failures", type=int, default=0,
+                        help="spot-check divergences tolerated before the "
+                             "campaign aborts; failures are recorded in "
+                             "the payload with forensics artifacts either "
+                             "way (default 0: any divergence is fatal)")
+    parser.add_argument("--spot-artifacts", type=str, default=None,
+                        metavar="DIR",
+                        help="directory for divergence forensics JSONL "
+                             "artifacts (default: system temp dir)")
+    parser.add_argument("--no-per-receiver", action="store_true",
+                        help="force every member onto the shared-state "
+                             "fast path (partition/flip-flop members "
+                             "lose the device-exact guarantee)")
     parser.add_argument("--weights", type=_parse_weights, default=None,
                         metavar="K=W,...",
                         help="scenario mix, e.g. crash=1,partition=2,"
@@ -277,7 +448,10 @@ def main(argv=None) -> int:
     cfg = CampaignConfig(clusters=args.clusters, n=args.n, ticks=args.ticks,
                          seed=args.seed, fleet_size=args.fleet_size,
                          headroom=args.headroom, weights=args.weights,
-                         spot_checks=args.spot_checks)
+                         spot_checks=args.spot_checks,
+                         per_receiver=not args.no_per_receiver,
+                         max_spot_failures=args.max_spot_failures,
+                         artifact_dir=args.spot_artifacts)
     payload = run_campaign(cfg)
     if args.out:
         with open(args.out, "w") as fh:

@@ -131,3 +131,50 @@ def aggregate(xp, state: EngineState, delivered_down, delivered_up,
     announce_now = any_new & ~in_flux & crossed.any() & ~state.announced
     return (reports, seen_down, announce_now, crossed,
             explicit_added, implicit_added)
+
+
+def receiver_aggregate(xp, reports, member, obs_full, delivered_down,
+                       gate, seen_down, settings):
+    """Per-receiver ``aggregate``: every slot runs its own detector copy.
+
+    ``reports``/``delivered_down`` are ``[C, C, K]`` (receiver, dst, ring),
+    ``member``/``obs_full`` the per-receiver view and observer tables,
+    ``gate``/``seen_down`` ``[C]``. The invalidation fixpoint is ONE global
+    ``lax.while_loop`` over the full tensor with per-receiver add gating
+    (ungated rows are fixed points), so divergent receivers don't trace
+    per-slot control flow. Returns
+    ``(reports, seen_down, any_new, in_flux, crossed)`` with the announce
+    decision left to the caller (it also needs the announced latch).
+    """
+    lo, hi = settings.L, settings.H
+    c = member.shape[0]
+    new = delivered_down & member[:, :, None] & gate[:, None, None]
+    reports = reports | new
+    any_new = new.any(axis=(1, 2))
+    seen_down = seen_down | any_new
+    fix_gate = any_new & seen_down
+    ridx = xp.arange(c, dtype=xp.int32)[:, None, None]
+
+    def fix_body(r):
+        counts = r.sum(axis=2)
+        flux = (counts >= lo) & (counts < hi)
+        obs_in_sets = (counts >= lo)[ridx, obs_full]
+        add = flux[:, :, None] & obs_in_sets & ~r & fix_gate[:, None, None]
+        return r | add
+
+    def body(carry):
+        r_cur, _ = carry
+        r_next = fix_body(r_cur)
+        return r_next, (r_next != r_cur).any()
+
+    reports = lax.cond(
+        fix_gate.any(),
+        lambda r: lax.while_loop(lambda cr: cr[1], body,
+                                 (r, xp.asarray(True)))[0],
+        lambda r: r,
+        reports)
+
+    counts = reports.sum(axis=2)
+    in_flux = ((counts >= lo) & (counts < hi)).any(axis=1)
+    crossed = counts >= hi
+    return reports, seen_down, any_new, in_flux, crossed

@@ -896,3 +896,74 @@ def run_adversarial_differential(
         oracle_metrics=telemetry_metrics.oracle_metrics(
             oracle_counts, all_oracle_events),
     )
+
+
+def run_receiver_differential(
+    schedule,
+    n_ticks: int,
+    settings: Optional[Settings] = None,
+) -> AdversaryDiffResult:
+    """Replay a link-fault :class:`rapid_tpu.faults.AdversarySchedule`
+    through the host per-slot adversary engine and the *device*
+    per-receiver kernel (``engine.receiver``).
+
+    This is the fidelity proof for fleet per-receiver members: the device
+    side runs the whole scenario inside one jitted ``lax.scan`` —
+    per-slot views, explicit wire, link reachability evaluated per
+    (sender, receiver) edge at delivery — and must reproduce the host
+    referee's per-slot event streams, per-tick counters, per-phase
+    consensus traffic and per-slot final config ids bit-identically.
+    Campaign spot checks call this as belt-and-suspenders; the campaign
+    result itself is device-exact without it.
+
+    Scripted proposes are outside the per-receiver envelope (fleet
+    lowering keeps those members on the shared-state path), and a sticky
+    device flag raises :class:`rapid_tpu.engine.receiver.ReceiverEnvelopeError`
+    rather than letting an out-of-envelope run masquerade as exact.
+    """
+    from rapid_tpu.engine import receiver as receiver_mod
+    from rapid_tpu.engine.adversary import AdversaryEngine
+    from rapid_tpu.engine.state import link_faults
+    from rapid_tpu.faults import validate_schedule
+    from rapid_tpu.oracle.membership_view import id_fingerprint, uid_of
+
+    validate_schedule(schedule)
+    if schedule.proposes:
+        raise ValueError("per-receiver mode does not support scripted "
+                         "proposes; use run_adversarial_differential")
+    settings = settings or Settings()
+    n = schedule.n
+    uids = [uid_of(e) for e in default_endpoints(n)]
+    id_fp_sum = sum(id_fingerprint(nid)
+                    for nid in default_node_ids(n)) & ((1 << 64) - 1)
+
+    # --- host referee ---------------------------------------------------
+    host = AdversaryEngine(schedule, uids, id_fp_sum, settings).run(n_ticks)
+
+    # --- device side ----------------------------------------------------
+    rs = receiver_mod.init_receiver_state(uids, id_fp_sum, settings,
+                                          seed=schedule.seed)
+    faults = link_faults(schedule.crash_tick_array().tolist(),
+                         schedule.windows, rs.member.shape[0])
+    final, logs = receiver_mod.receiver_simulate(rs, faults, n_ticks,
+                                                 settings)
+    receiver_mod.check_flags(final.flags)
+    dev = receiver_mod.receiver_run_payload(final, logs, n, n_ticks)
+
+    def as_view_events(evs):
+        return [[ViewEvent(tick=t, kind=k, config_id=c, slots=slots)
+                 for t, k, c, slots in slot_evs] for slot_evs in evs]
+
+    return AdversaryDiffResult(
+        n=n, n_ticks=n_ticks, schedule=schedule,
+        oracle_events_by_slot=as_view_events(host.events_by_slot),
+        engine_events_by_slot=as_view_events(dev.events_by_slot),
+        oracle_counters=host.tick_history,
+        engine_counters=dev.tick_history,
+        oracle_phase_counters=host.phase_history,
+        engine_phase_counters=dev.phase_history,
+        oracle_config_ids=host.config_ids,
+        engine_config_ids=dev.config_ids,
+        engine_metrics=dev.metrics(),
+        oracle_metrics=host.metrics(),
+    )

@@ -32,15 +32,29 @@ is the device twin of exactly the scenario the host adversary referees.
 
 Fidelity envelope
 -----------------
-The fleet runs the *shared-state* step (see ``state.py``): exact for
-crash, scripted-propose and scheduled-churn scenarios; for link faults
-the shared kernel applies the window masks to its failure-detector
-probes but keeps one shared cut/consensus state, so partition members
-are a benchmark-scale approximation. The per-receiver host adversary
-(``engine.adversary`` via ``diff.run_adversarial_differential``) stays
-the exactness referee: campaigns spot-check sampled members against it
-per slot (``rapid_tpu.campaign``), which is the only part of a campaign
-that remains host-side.
+Fleet members run in one of two modes, chosen statically per member
+kind at lowering time:
+
+- **Shared-state** (``lower_schedule`` / ``stack_members``): one merged
+  cut/consensus state per cluster, ``O(C·K)`` memory, exact for crash,
+  scripted-propose and scheduled-churn scenarios. This stays the fast
+  path for the crash/churn/contested member kinds.
+- **Per-receiver** (``lower_receiver_schedule`` /
+  ``stack_receiver_members``): every slot carries its own view, wire
+  messages are stamped with the sender's config and recipient snapshot,
+  and ``LinkWindow`` reachability is evaluated per (sender, receiver)
+  edge at delivery — the semantics the host adversary
+  (``engine.adversary``) replays sequentially, now on device inside the
+  same ``lax.scan``. Partition and flip-flop members are **device-exact**:
+  campaigns report their per-slot event streams and counters without any
+  host referee in the loop, and
+  ``diff.run_receiver_differential`` re-proves the bit-identity as a
+  belt-and-suspenders spot check. The cost is quadratic per-member state
+  (``[C, C, K]`` report/topology tensors plus explicit wire buffers);
+  ``receiver.receiver_state_bytes`` sizes it exactly and
+  ``check_receiver_budget`` refuses fleets beyond
+  ``Settings.receiver_capacity_cap`` with a structured
+  :class:`ReceiverBudgetError` before anything is allocated.
 """
 from __future__ import annotations
 
@@ -59,12 +73,18 @@ from rapid_tpu.settings import Settings
 
 __all__ = [
     "FleetMember",
+    "ReceiverBudgetError",
+    "ReceiverMember",
+    "check_receiver_budget",
     "fleet_simulate",
     "fleet_trace_count",
+    "lower_receiver_schedule",
     "lower_schedule",
     "member_logs",
+    "receiver_fleet_simulate",
     "reset_fleet_trace_count",
     "stack_members",
+    "stack_receiver_members",
 ]
 
 
@@ -273,3 +293,124 @@ def member_logs(logs, i: int):
     import jax
 
     return jax.tree_util.tree_map(lambda x: x[i], logs)
+
+
+# --- per-receiver fleet members (exact link faults on device) ------------
+
+
+class ReceiverMember(NamedTuple):
+    """One per-receiver cluster: quadratic state + its fault program."""
+
+    state: object               # receiver.ReceiverState
+    faults: EngineFaults
+
+
+class ReceiverBudgetError(ValueError):
+    """A per-receiver fleet would exceed the sized memory budget.
+
+    Raised *before* any device allocation, with the measured per-member
+    and total byte costs in the message — the structured alternative to
+    an opaque device OOM mid-campaign."""
+
+    def __init__(self, capacity: int, fleet_size: int, cap: int,
+                 member_bytes: int, total_bytes: int) -> None:
+        self.capacity = capacity
+        self.fleet_size = fleet_size
+        self.cap = cap
+        self.member_bytes = member_bytes
+        self.total_bytes = total_bytes
+        super().__init__(
+            f"per-receiver fleet over budget: capacity {capacity} > "
+            f"receiver_capacity_cap {cap} "
+            f"({member_bytes / 2**20:.1f} MiB/member, "
+            f"{total_bytes / 2**20:.1f} MiB for fleet of {fleet_size}; "
+            f"raise Settings.receiver_capacity_cap to override)")
+
+
+def check_receiver_budget(capacity: int, fleet_size: int,
+                          settings: Settings) -> int:
+    """Size a per-receiver fleet; returns per-member bytes or raises
+    :class:`ReceiverBudgetError` when ``capacity`` exceeds
+    ``settings.receiver_capacity_cap``."""
+    from rapid_tpu.engine.receiver import receiver_state_bytes
+
+    member_bytes = receiver_state_bytes(capacity, settings.K)
+    if capacity > settings.receiver_capacity_cap:
+        raise ReceiverBudgetError(capacity, fleet_size,
+                                  settings.receiver_capacity_cap,
+                                  member_bytes, member_bytes * fleet_size)
+    return member_bytes
+
+
+def lower_receiver_schedule(schedule: AdversarySchedule,
+                            settings: Settings, *,
+                            uids: Optional[Sequence[int]] = None,
+                            id_fp_sum: Optional[int] = None,
+                            fleet_size: int = 1) -> ReceiverMember:
+    """Compile one link-fault ``AdversarySchedule`` into a device
+    :class:`ReceiverMember` (the per-receiver analogue of
+    ``lower_schedule``).
+
+    Scripted proposes and churn are shared-state-only member kinds and
+    are rejected here — campaign dispatch routes them to the fast path.
+    The budget check runs first so oversized fleets fail structurally
+    before any quadratic allocation.
+    """
+    from rapid_tpu.engine.receiver import init_receiver_state
+
+    validate_schedule(schedule)
+    if schedule.proposes:
+        raise ValueError("per-receiver members do not support scripted "
+                         "proposes; lower with lower_schedule instead")
+    n = schedule.n
+    c = max(settings.capacity, n)
+    eff = settings if settings.capacity == c else settings.with_(capacity=c)
+    check_receiver_budget(c, fleet_size, eff)
+    if uids is None:
+        uids, default_sum = _default_identities(n)
+        if id_fp_sum is None:
+            id_fp_sum = default_sum
+    elif id_fp_sum is None:
+        id_fp_sum = 0
+    state = init_receiver_state(uids, id_fp_sum, eff, seed=schedule.seed)
+    crash = np.full(c, np.iinfo(np.int32).max, np.int64)
+    crash[:n] = schedule.crash_tick_array()
+    faults = link_faults(crash.tolist(), schedule.windows, c)
+    return ReceiverMember(state=state, faults=faults)
+
+
+def stack_receiver_members(members: Sequence[ReceiverMember]
+                           ) -> ReceiverMember:
+    """Stack per-receiver members along a new leading fleet axis.
+
+    Same contract as ``stack_members``: shared capacity, link windows
+    padded to the fleet max with inert rows. The ``[C, C, K]`` leaves
+    become ``[F, C, C, K]`` — ``sharding.fleet_spec_for`` keeps the
+    fleet axis replicated and shards only the slot axis.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not members:
+        raise ValueError("empty fleet")
+    c0 = int(members[0].state.member.shape[0])
+    for m in members:
+        if int(m.state.member.shape[0]) != c0:
+            raise ValueError("fleet members must share one capacity")
+    w = max(m.faults.n_windows for m in members)
+    members = [m._replace(faults=pad_link_windows(m.faults, w))
+               for m in members]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *members)
+
+
+def receiver_fleet_simulate(fleet: ReceiverMember, n_ticks: int,
+                            settings: Settings) -> tuple:
+    """Run a stacked per-receiver fleet in one jitted dispatch.
+
+    Returns ``(final_states, logs)`` with a leading fleet axis on every
+    leaf, like ``fleet_simulate``. The tick body traces once regardless
+    of F."""
+    from rapid_tpu.engine.receiver import receiver_fleet_simulate as _run
+
+    return _run(fleet.state, fleet.faults, int(n_ticks), settings)

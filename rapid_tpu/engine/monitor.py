@@ -59,6 +59,28 @@ def link_blocked(xp, faults: EngineFaults, src_idx, dst_idx, tick):
     return blocked
 
 
+def link_blocked_matrix(xp, faults: EngineFaults, tick):
+    """bool [C, C]: full directed edge drop matrix at delivery tick ``tick``.
+
+    The per-receiver kernel evaluates reachability per (sender, receiver)
+    edge for every wire class, so it pays for the dense matrix once per
+    tick instead of W masked gathers per message set. Self-edges can block
+    (a slot in both a window's src and dst sets drops its own broadcasts),
+    exactly as the oracle's ``_edge_matrix``. All-False when no windows.
+    """
+    c = faults.crash_tick.shape[0]
+    blocked = xp.zeros((c, c), bool)
+    if faults.n_windows == 0:
+        return blocked
+    active = link_window_active(xp, faults, tick)
+    for w in range(faults.n_windows):
+        src_w, dst_w = faults.link_src[w], faults.link_dst[w]
+        hit = src_w[:, None] & dst_w[None, :]
+        hit |= faults.link_two_way[w] & (dst_w[:, None] & src_w[None, :])
+        blocked |= active[w] & hit
+    return blocked
+
+
 def partitioned_edge_count(xp, faults: EngineFaults, member, tick):
     """i32 gauge: directed member->member pairs blocked by active windows.
 

@@ -650,3 +650,33 @@ def synthetic_contested_schedule(
     if not parts:
         return empty_fallback_schedule(n), info
     return concat_schedules(parts), info
+
+
+def build_delay_table(
+    seed: int,
+    capacity: int,
+    n_draws: int,
+    settings: Settings,
+) -> np.ndarray:
+    """Precompute every fallback-timer delay the per-receiver kernel can draw.
+
+    The oracle draws ``u = rngs[slot].random()`` lazily, once per announce,
+    and maps it through ``expovariate_delay_ticks(u, px.n)`` where ``px.n``
+    is the *current instance size* — a value only known on device. The
+    draw sequence per slot is deterministic (``adversary_rngs``), so the
+    host can enumerate the first ``n_draws`` uniforms per slot and tabulate
+    the delay for every possible instance size ``m`` in ``0..capacity``:
+    ``table[slot, draw, m]``. The device then gathers
+    ``table[r, draws[r], px_n[r]]`` — bit-exact including python's
+    banker's rounding, which jnp.round does not reproduce.
+    """
+    from rapid_tpu.engine.adversary import adversary_rngs
+
+    rngs = adversary_rngs(seed, capacity)
+    table = np.zeros((capacity, n_draws, capacity + 1), np.int32)
+    for s in range(capacity):
+        for d in range(n_draws):
+            u = rngs[s].random()
+            for m in range(capacity + 1):
+                table[s, d, m] = expovariate_delay_ticks(u, m, settings)
+    return table

@@ -1,0 +1,940 @@
+"""Per-receiver tick engine: exact link faults on device.
+
+The shared-state step (``engine.step``) collapses all N per-node detector
+and consensus copies into one — exact for crash faults, where every alive
+receiver observes the identical alert stream, but an approximation under
+``LinkWindow`` faults, which split the receiver set. This module runs the
+protocol with *every slot carrying its own view* (``state.ReceiverState``)
+and an explicit wire (one in-flight buffer per message kind, stamped with
+the sender's cfg + recipient snapshot), evaluating link reachability at
+delivery per (sender, receiver) edge inside ``lax.scan`` — the same
+semantics ``engine.adversary`` replays sequentially on the host, now as a
+single XLA program that ``vmap``s over a fleet axis.
+
+Wire order
+----------
+The oracle delivers messages in global send order (wseq). Sends at tick
+``t-1`` happen in a fixed sequence — 2b during 2a delivery, 2a during 1b
+delivery, 1b during 1a delivery, votes during batch delivery (announce),
+then ``_run_due``: 1a from timers, batches from batchers — so deliveries
+at ``t`` group exactly as ``2b, 2a, 1b, vote, 1a, batch``, which is the
+phase order of :func:`receiver_step`. Within a group, arrival order is
+recovered from announce-order keys (``t*(C+1) + ring0 position``): the
+oracle's scheduler handles are creation-ordered, and every racing sender
+acquired its key at announce time. Order-dependent triggers (fast-vote
+quorum crossing, 1a rank prefix-max, 1b majority crossing + value
+selection, ascending-rank 2a accept chains) are evaluated as prefix
+reductions over that order — exact, not approximate, for the scenarios
+the differential suite pins (see ``Envelope`` below).
+
+Envelope
+--------
+Supported fault inputs: crash schedules plus arbitrary ``LinkWindow``
+sets (one-way/two-way, flip-flop periods). Scripted proposes and churn
+are *not* supported — fleet lowering keeps those member kinds on the
+shared-state fast path. Deep races outside the committed differential
+envelope set sticky ``flags`` bits rather than silently diverging:
+multiple tracked 2b rounds per listener, more than two same-tick 2a
+accepts per acceptor, a proposal fingerprint missing from the announce
+registry, or a slot exhausting its precomputed fallback-delay draws.
+``diff.run_receiver_differential`` asserts the flags stay zero for every
+scenario it verifies.
+
+Memory is quadratic per member by design (``[C, C, K]`` report/topology
+tensors): :func:`receiver_state_bytes` sizes it, and fleet lowering
+refuses capacities above ``Settings.receiver_capacity_cap`` with a
+structured error (see ``engine.fleet.ReceiverBudgetError``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rapid_tpu import hashing
+from rapid_tpu.engine import cut, monitor
+from rapid_tpu.engine.state import (
+    I32_MAX, EngineFaults, ReceiverState, ReceiverStepLog, config_id_limbs)
+from rapid_tpu.settings import Settings
+
+#: Fallback-delay draws precomputed per slot (one per announce; a slot
+#: announcing in more than N_DRAWS configurations overflows -> flag bit).
+N_DRAWS = 4
+
+# Sticky envelope / error bits in ``ReceiverState.flags``.
+FLAG_DECIDE_NOT_IN_VIEW = 1   # device analogue of AdversaryExecutionError
+FLAG_DRAWS_EXHAUSTED = 2
+FLAG_MULTI_2A_ACCEPTS = 4     # >2 same-tick ascending-rank accepts
+FLAG_MULTI_2B_ROUNDS = 8      # 2b traffic across distinct rounds
+FLAG_REGISTRY_MISS = 16       # vote/2a fingerprint not in announce registry
+
+_FLAG_NAMES = {
+    FLAG_DECIDE_NOT_IN_VIEW: "decide-host-not-in-view",
+    FLAG_DRAWS_EXHAUSTED: "fallback-delay-draws-exhausted",
+    FLAG_MULTI_2A_ACCEPTS: "more-than-two-same-tick-2a-accepts",
+    FLAG_MULTI_2B_ROUNDS: "multiple-2b-rounds-tracked",
+    FLAG_REGISTRY_MISS: "proposal-registry-miss",
+}
+
+
+class ReceiverEnvelopeError(RuntimeError):
+    """A per-receiver run tripped a sticky envelope flag: the scenario
+    drove the protocol outside the race depth the kernel tracks exactly,
+    so its results must not be reported as device-exact."""
+
+
+def decode_flags(flags) -> List[str]:
+    f = int(np.asarray(flags))
+    return [name for bit, name in sorted(_FLAG_NAMES.items()) if f & bit]
+
+
+def check_flags(flags) -> None:
+    names = decode_flags(flags)
+    if names:
+        raise ReceiverEnvelopeError(
+            "per-receiver run left the exactness envelope: "
+            + ", ".join(names))
+
+
+def _cfg_eq(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi == b_hi) & (a_lo == b_lo)
+
+
+def _account(xp, msgs, crashed, emat):
+    """Delivery mask + (delivered, dropped, link_dropped) counts for one
+    message set ``msgs[src, dst]``, with the oracle's drop precedence:
+    crashed src first, then crashed dst / link block (``link_dropped``
+    only counts blocks whose endpoints are both alive)."""
+    src_ok = ~crashed[:, None]
+    dst_ok = ~crashed[None, :]
+    deliv = msgs & src_ok & dst_ok & ~emat
+    dropped = (msgs & ~deliv).sum().astype(xp.int32)
+    linkd = (msgs & src_ok & dst_ok & emat).sum().astype(xp.int32)
+    return deliv, deliv.sum().astype(xp.int32), dropped, linkd
+
+
+def _prefmax_excl(xp, vals):
+    """Exclusive running max along the last axis (identity = -1)."""
+    inc = lax.cummax(vals, axis=vals.ndim - 1)
+    pad = xp.full(vals.shape[:-1] + (1,), -1, vals.dtype)
+    return xp.concatenate([pad, inc[..., :-1]], axis=-1)
+
+
+def _proposal_fp_rows(xp, masks, uid_hi, uid_lo):
+    """Row-wise ``votes.proposal_fingerprint``: ``[R, C]`` masks -> two
+    ``[R]`` limb arrays (same hash, batched via ``sum64_axis``)."""
+    phi, plo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=0x70726F70)
+    m = masks.astype(xp.uint32)
+    shi, slo = hashing.sum64_axis(xp, phi[None, :] * m, plo[None, :] * m)
+    return hashing.splitmix64_limbs(xp, shi, slo)
+
+
+def _registry_lookup(xp, reg_valid, reg_mask, reg_fp_hi, reg_fp_lo,
+                     fp_hi, fp_lo, want):
+    """Resolve per-receiver fingerprints ``[R]`` to proposal masks
+    ``[R, C]`` via the announce registry; ``found`` is False (and the
+    mask empty) on a miss."""
+    hit = (reg_valid[None, :] & (reg_fp_hi[None, :] == fp_hi[:, None])
+           & (reg_fp_lo[None, :] == fp_lo[:, None]))
+    found = hit.any(axis=1) & want
+    idx = xp.argmax(hit, axis=1)
+    mask = reg_mask[idx] & found[:, None]
+    return mask, found, (want & ~found).any()
+
+
+def _pick_min_seq(xp, mask, seqs):
+    """Per row: index of the mask element with the smallest seq key."""
+    keyed = xp.where(mask, seqs, I32_MAX)
+    return xp.argmin(keyed, axis=1), mask.any(axis=1)
+
+
+class _Vars:
+    """Mutable working copy of the per-tick state (threaded through the
+    step's delivery groups; ``finalize`` rebuilds the NamedTuple)."""
+
+    def __init__(self, rs: ReceiverState):
+        for name in ReceiverState._fields:
+            setattr(self, name, getattr(rs, name))
+
+
+def _apply_decides(xp, v: _Vars, t, dm, hosts):
+    """Apply a wave of view-change decides: remove ``hosts[r]`` from
+    ``r``'s view where ``dm[r]``, recompute cfg, reset per-config state
+    (the oracle's ``_decide_view_change``). The alert queue (``pf``) is
+    deliberately *not* reset — its stale contents flush next tick with
+    old cfg stamps to the new recipient set (dead traffic the oracle
+    reproduces). Returns the post-decide cfg limbs for the event log."""
+    c = v.member.shape[1]
+    bad = dm & (hosts & ~v.member).any(axis=1)
+    v.flags = v.flags | xp.where(bad.any(), FLAG_DECIDE_NOT_IN_VIEW, 0)
+    hosts = hosts & v.member & dm[:, None]
+
+    hm = hosts.astype(xp.uint32)
+    rem_hi, rem_lo = hashing.sum64_axis(
+        xp, v.mfp_hi[None, :] * hm, v.mfp_lo[None, :] * hm)
+    ms_hi, ms_lo = hashing.sub64(xp, v.memsum_hi, v.memsum_lo,
+                                 rem_hi, rem_lo)
+    v.memsum_hi = xp.where(dm, ms_hi, v.memsum_hi)
+    v.memsum_lo = xp.where(dm, ms_lo, v.memsum_lo)
+    cfg2_hi, cfg2_lo = config_id_limbs(
+        xp, v.idsum_hi, v.idsum_lo, v.memsum_hi, v.memsum_lo)
+    v.cfg_hi = xp.where(dm, cfg2_hi, v.cfg_hi)
+    v.cfg_lo = xp.where(dm, cfg2_lo, v.cfg_lo)
+
+    v.member = v.member & ~hosts
+    v.epoch = v.epoch + dm.astype(xp.int32)
+    ridx = xp.arange(c, dtype=xp.int32)
+    self_in = v.member[ridx, ridx]
+    v.stopped = v.stopped | (dm & ~self_in)
+    v.px_n = xp.where(dm, v.member.sum(axis=1).astype(xp.int32), v.px_n)
+
+    z1, z2, z3 = dm, dm[:, None], dm[:, None, None]
+    v.reports = v.reports & ~z3
+    v.seen_down = v.seen_down & ~z1
+    v.announced = v.announced & ~z1
+    v.ar_seq = xp.where(z1, I32_MAX, v.ar_seq)
+    v.fc = xp.where(z2, 0, v.fc)
+    v.notified = v.notified & ~z2
+    v.fd_gate = xp.where(z1, t, v.fd_gate)
+    v.vt_seen = v.vt_seen & ~z2
+    zero_i = xp.zeros_like(v.px_rnd_r)
+    v.px_rnd_r = xp.where(z1, zero_i, v.px_rnd_r)
+    v.px_rnd_i = xp.where(z1, zero_i, v.px_rnd_i)
+    v.px_vrnd_r = xp.where(z1, zero_i, v.px_vrnd_r)
+    v.px_vrnd_i = xp.where(z1, zero_i, v.px_vrnd_i)
+    v.px_vv_set = v.px_vv_set & ~z1
+    v.px_crnd_r = xp.where(z1, zero_i, v.px_crnd_r)
+    v.px_cval_set = v.px_cval_set & ~z1
+    v.px_timer = xp.where(z1, I32_MAX, v.px_timer)
+    v.pb_seen = v.pb_seen & ~z2
+    v.p2_rnd = xp.where(z1, -1, v.p2_rnd)
+    v.p2_seen = v.p2_seen & ~z2
+    return v.cfg_hi, v.cfg_lo
+
+
+def receiver_step(rs: ReceiverState, faults: EngineFaults,
+                  settings: Settings
+                  ) -> Tuple[ReceiverState, ReceiverStepLog]:
+    """One tick of the per-receiver engine (see module docstring for the
+    delivery-group order and its wseq-equivalence argument)."""
+    xp = jnp
+    v = _Vars(rs)
+    t = rs.tick + 1
+    c = rs.member.shape[0]
+    ridx = xp.arange(c, dtype=xp.int32)
+    jidx = ridx
+    crashed = monitor.crashed_at(faults, t)
+    emat = monitor.link_blocked_matrix(xp, faults, t)
+    i32 = lambda x: xp.int32(x)
+    pop = lambda m: m.sum(axis=1).astype(xp.int32)   # popcount of mask rows
+
+    sent = i32(0)
+    delivered = i32(0)
+    dropped = i32(0)
+    link_dropped = i32(0)
+    phase_sent = {p: i32(0) for p in ("fv", "p1a", "p1b", "p2a", "p2b")}
+    phase_del = {p: i32(0) for p in ("fv", "p1a", "p1b", "p2a", "p2b")}
+
+    dec_mask = xp.zeros((c,), bool)
+    dec_hosts = xp.zeros((c, c), bool)
+    dec_cfg_hi = xp.zeros((c,), xp.uint32)
+    dec_cfg_lo = xp.zeros((c,), xp.uint32)
+
+    def deliver(msgs, phase=None):
+        nonlocal delivered, dropped, link_dropped
+        dv, dn, dr, ld = _account(xp, msgs, crashed, emat)
+        delivered += dn
+        dropped += dr
+        link_dropped += ld
+        if phase is not None:
+            phase_del[phase] = phase_del[phase] + dn
+        return dv
+
+    def record_decides(dm, hosts, cfg_hi, cfg_lo):
+        nonlocal dec_mask, dec_hosts, dec_cfg_hi, dec_cfg_lo
+        dec_mask = dec_mask | dm
+        dec_hosts = xp.where(dm[:, None], hosts, dec_hosts)
+        dec_cfg_hi = xp.where(dm, cfg_hi, dec_cfg_hi)
+        dec_cfg_lo = xp.where(dm, cfg_lo, dec_cfg_lo)
+
+    # ---- group 1: phase-2b delivery -> decide wave A --------------------
+    gates = []
+    for slot in (0, 1):
+        msgs = rs.w2b[slot][:, None] & rs.w2b_bcast
+        dv = deliver(msgs, "p2b")
+        arr = dv.T
+        gates.append(arr & ~v.stopped[:, None]
+                     & _cfg_eq(rs.w2b_cfg_hi[None, :], rs.w2b_cfg_lo[None, :],
+                               v.cfg_hi[:, None], v.cfg_lo[:, None]))
+    rnd0 = xp.where(gates[0], rs.w2b_rnd[0][None, :], -1)
+    rnd1 = xp.where(gates[1], rs.w2b_rnd[1][None, :], -1)
+    mx_in = xp.maximum(rnd0.max(axis=1), rnd1.max(axis=1))
+    mx = xp.maximum(v.p2_rnd, mx_in)
+    reset = mx > v.p2_rnd
+    use0 = gates[0] & (rs.w2b_rnd[0][None, :] == mx[:, None])
+    use1 = gates[1] & (rs.w2b_rnd[1][None, :] == mx[:, None])
+    low_seen = ((gates[0] & ~use0).any() | (gates[1] & ~use1).any()
+                | (reset & (v.p2_rnd >= 0) & v.p2_seen.any(axis=1)).any())
+    v.flags = v.flags | xp.where(low_seen, FLAG_MULTI_2B_ROUNDS, 0)
+    add = use0 | use1
+    seen_base = v.p2_seen & ~reset[:, None]
+    v.p2_seen = seen_base | add
+    a_star = xp.argmax(add, axis=1)
+    pick0 = use0[ridx, a_star]
+    gathered = xp.where(pick0[:, None], rs.w2b_mask[0][a_star],
+                        rs.w2b_mask[1][a_star])
+    refresh = reset & add.any(axis=1)
+    v.p2_mask = xp.where(refresh[:, None], gathered, v.p2_mask)
+    v.p2_rnd = mx
+    dec_a = (v.p2_seen.sum(axis=1) > v.px_n // 2) & add.any(axis=1)
+    hosts_a = v.p2_mask & dec_a[:, None]
+
+    # ---- group 2: apply decide wave A -----------------------------------
+    ncfg_hi, ncfg_lo = _apply_decides(xp, v, t, dec_a, hosts_a)
+    record_decides(dec_a, hosts_a, ncfg_hi, ncfg_lo)
+
+    # ---- group 3: phase-2a delivery -> accept chain -> 2b emission ------
+    msgs = rs.w2a[:, None] & rs.w2a_bcast
+    dv = deliver(msgs, "p2a")
+    arr = dv.T
+    gate = (arr & ~v.stopped[:, None]
+            & _cfg_eq(rs.w2a_cfg_hi[None, :], rs.w2a_cfg_lo[None, :],
+                      v.cfg_hi[:, None], v.cfg_lo[:, None]))
+    perm3 = xp.argsort(xp.where(rs.w2a, rs.w2a_seq, I32_MAX))
+    gate_s = gate[:, perm3]
+    rank_j = rs.rank_idx[perm3]
+    ge0 = ((v.px_rnd_r[:, None] < 2)
+           | ((v.px_rnd_r[:, None] == 2)
+              & (v.px_rnd_i[:, None] <= rank_j[None, :])))
+    ne0 = ~((v.px_vrnd_r[:, None] == 2)
+            & (v.px_vrnd_i[:, None] == rank_j[None, :]))
+    arrived = xp.where(gate_s, rank_j[None, :], -1)
+    accept = gate_s & ge0 & ne0 & (rank_j[None, :] > _prefmax_excl(xp, arrived))
+    n_acc = accept.sum(axis=1).astype(xp.int32)
+    v.flags = v.flags | xp.where((n_acc > 2).any(), FLAG_MULTI_2A_ACCEPTS, 0)
+    j1 = xp.argmax(accept, axis=1)
+    j2 = xp.argmax(accept & (jidx[None, :] > j1[:, None]), axis=1)
+    jl = c - 1 - xp.argmax(accept[:, ::-1], axis=1)
+    c1, c2, cl = perm3[j1], perm3[j2], perm3[jl]
+    emit0 = n_acc >= 1
+    emit1 = n_acc >= 2
+    w2b_new = xp.stack([emit0, emit1])
+    w2b_rnd_new = xp.stack([rs.rank_idx[c1], rs.rank_idx[c2]])
+    w2b_fp_hi_new = xp.stack([rs.w2a_fp_hi[c1], rs.w2a_fp_hi[c2]])
+    w2b_fp_lo_new = xp.stack([rs.w2a_fp_lo[c1], rs.w2a_fp_lo[c2]])
+    w2b_mask_new = xp.stack([rs.w2a_mask[c1], rs.w2a_mask[c2]])
+    w2b_cfg_hi_new, w2b_cfg_lo_new = v.cfg_hi, v.cfg_lo
+    w2b_bcast_new = v.member
+    n_2b = (emit0 * pop(v.member) + emit1 * pop(v.member)).sum().astype(
+        xp.int32)
+    phase_sent["p2b"] += n_2b
+    sent += n_2b
+    rank_last = rs.rank_idx[cl]
+    v.px_rnd_r = xp.where(emit0, 2, v.px_rnd_r)
+    v.px_rnd_i = xp.where(emit0, rank_last, v.px_rnd_i)
+    v.px_vrnd_r = xp.where(emit0, 2, v.px_vrnd_r)
+    v.px_vrnd_i = xp.where(emit0, rank_last, v.px_vrnd_i)
+    v.px_vv_fp_hi = xp.where(emit0, rs.w2a_fp_hi[cl], v.px_vv_fp_hi)
+    v.px_vv_fp_lo = xp.where(emit0, rs.w2a_fp_lo[cl], v.px_vv_fp_lo)
+    v.px_vv_set = v.px_vv_set | emit0
+
+    # ---- group 4: phase-1b delivery -> crossing + selection -> 2a -------
+    msgs = rs.w1b
+    dv = deliver(msgs, "p1b")
+    arr = dv.T                                   # [coordinator, promiser]
+    gate = (arr & ~v.stopped[:, None] & (v.px_crnd_r[:, None] == 2)
+            & _cfg_eq(rs.w1b_cfg_hi[None, :], rs.w1b_cfg_lo[None, :],
+                      v.cfg_hi[:, None], v.cfg_lo[:, None]))
+    new = gate & ~v.pb_seen
+    seq_in = t * (c + 1) + rs.rx_pos
+    v.pb_seen = v.pb_seen | new
+    v.pb_vrnd_r = xp.where(new, rs.w1b_vrnd_r[None, :], v.pb_vrnd_r)
+    v.pb_vrnd_i = xp.where(new, rs.w1b_vrnd_i[None, :], v.pb_vrnd_i)
+    v.pb_fp_hi = xp.where(new, rs.w1b_fp_hi[None, :], v.pb_fp_hi)
+    v.pb_fp_lo = xp.where(new, rs.w1b_fp_lo[None, :], v.pb_fp_lo)
+    v.pb_set = xp.where(new, rs.w1b_set[None, :], v.pb_set)
+    v.pb_seq = xp.where(new, seq_in[None, :], v.pb_seq)
+
+    prior = v.pb_seen & ~new
+    prior_tot = prior.sum(axis=1).astype(xp.int32)
+    prior_ne = (prior & v.pb_set).sum(axis=1).astype(xp.int32)
+    perm2 = xp.argsort(rs.rx_pos)
+    new_s = new[:, perm2]
+    ne_new_s = new_s & rs.w1b_set[perm2][None, :]
+    cum_tot = prior_tot[:, None] + xp.cumsum(new_s, axis=1)
+    cum_ne = prior_ne[:, None] + xp.cumsum(ne_new_s, axis=1)
+    thr = v.px_n // 2 + 1
+    elig = new_s & (cum_tot >= thr[:, None]) & (cum_ne >= 1)
+    cross = elig.any(axis=1) & ~v.px_cval_set
+    jstar = xp.argmax(elig, axis=1)
+    sstar = t * (c + 1) + rs.rx_pos[perm2[jstar]]
+    prefix = v.pb_seen & (v.pb_seq <= sstar[:, None])
+
+    vr = xp.where(prefix, v.pb_vrnd_r, -1)
+    mr = vr.max(axis=1)
+    vi = xp.where(prefix & (v.pb_vrnd_r == mr[:, None]), v.pb_vrnd_i, -1)
+    mi = vi.max(axis=1)
+    maxmask = prefix & (v.pb_vrnd_r == mr[:, None]) & (v.pb_vrnd_i == mi[:, None])
+    collected = maxmask & v.pb_set
+    ncoll = collected.sum(axis=1).astype(xp.int32)
+    eqf = ((v.pb_fp_hi[:, :, None] == v.pb_fp_hi[:, None, :])
+           & (v.pb_fp_lo[:, :, None] == v.pb_fp_lo[:, None, :]))
+    pair_uneq = (collected[:, :, None] & collected[:, None, :]
+                 & ~eqf).any(axis=(1, 2))
+    single = (ncoll >= 1) & ~pair_uneq
+    earlier = v.pb_seq[:, None, :] < v.pb_seq[:, :, None]
+    occ = (collected[:, None, :] & eqf & earlier).sum(axis=2).astype(xp.int32)
+    cand = collected & pair_uneq[:, None] & (occ == (v.px_n // 4)[:, None])
+    d_single, _ = _pick_min_seq(xp, collected, v.pb_seq)
+    d_cand, has_cand = _pick_min_seq(xp, cand, v.pb_seq)
+    d_fall, _ = _pick_min_seq(xp, prefix & v.pb_set, v.pb_seq)
+    d_star = xp.where(single, d_single, xp.where(has_cand, d_cand, d_fall))
+    chosen_fp_hi = v.pb_fp_hi[ridx, d_star]
+    chosen_fp_lo = v.pb_fp_lo[ridx, d_star]
+    res_mask, _, miss = _registry_lookup(
+        xp, v.reg_valid, v.reg_mask, v.reg_fp_hi, v.reg_fp_lo,
+        chosen_fp_hi, chosen_fp_lo, cross)
+    v.flags = v.flags | xp.where(miss, FLAG_REGISTRY_MISS, 0)
+    w2a_new = cross
+    w2a_fp_hi_new = xp.where(cross, chosen_fp_hi, 0).astype(xp.uint32)
+    w2a_fp_lo_new = xp.where(cross, chosen_fp_lo, 0).astype(xp.uint32)
+    w2a_mask_new = res_mask
+    w2a_cfg_hi_new, w2a_cfg_lo_new = v.cfg_hi, v.cfg_lo
+    w2a_seq_new = v.ar_seq
+    w2a_bcast_new = v.member
+    v.px_cval_set = v.px_cval_set | cross
+    n_2a = (cross * pop(v.member)).sum().astype(xp.int32)
+    phase_sent["p2a"] += n_2a
+    sent += n_2a
+
+    # ---- group 5: fast-vote delivery -> decide wave B -------------------
+    msgs = rs.wv[:, None] & rs.wv_bcast
+    dv = deliver(msgs, "fv")
+    arr = dv.T
+    gate = (arr & ~v.stopped[:, None]
+            & _cfg_eq(rs.wv_cfg_hi[None, :], rs.wv_cfg_lo[None, :],
+                      v.cfg_hi[:, None], v.cfg_lo[:, None]))
+    process = gate & ~v.vt_seen
+    perm_v = xp.argsort(xp.where(rs.wv, rs.wv_seq, I32_MAX))
+    proc_s = process[:, perm_v]
+    # Baseline: stored votes equal to each arriving fingerprint.
+    fp_eq_stored = ((v.vt_fp_hi[:, :, None] == rs.wv_fp_hi[perm_v][None, None, :])
+                    & (v.vt_fp_lo[:, :, None]
+                       == rs.wv_fp_lo[perm_v][None, None, :]))
+    baseline = (v.vt_seen[:, :, None] & fp_eq_stored).sum(axis=1).astype(
+        xp.int32)
+    prior_tot = v.vt_seen.sum(axis=1).astype(xp.int32)
+    # Arrival-prefix counts of equal fingerprints, in announce order.
+    fp_eq_wire = ((rs.wv_fp_hi[perm_v][:, None] == rs.wv_fp_hi[perm_v][None, :])
+                  & (rs.wv_fp_lo[perm_v][:, None]
+                     == rs.wv_fp_lo[perm_v][None, :]))
+    lower_tri = jidx[None, :] <= jidx[:, None]          # [j, j2]: j2 <= j
+    prefix_cnt = xp.einsum('rj,kj->rk', proc_s.astype(xp.int32),
+                           (fp_eq_wire & lower_tri).astype(xp.int32))
+    count_after = baseline + prefix_cnt
+    total_after = prior_tot[:, None] + xp.cumsum(proc_s, axis=1)
+    quorum = v.px_n - (v.px_n - 1) // 4
+    trig = (proc_s & (count_after >= quorum[:, None])
+            & (total_after >= quorum[:, None]))
+    dec_b = trig.any(axis=1)
+    win_j = xp.argmax(trig, axis=1)
+    win_fp_hi = rs.wv_fp_hi[perm_v[win_j]]
+    win_fp_lo = rs.wv_fp_lo[perm_v[win_j]]
+    hosts_b, _, miss = _registry_lookup(
+        xp, v.reg_valid, v.reg_mask, v.reg_fp_hi, v.reg_fp_lo,
+        win_fp_hi, win_fp_lo, dec_b)
+    v.flags = v.flags | xp.where(miss, FLAG_REGISTRY_MISS, 0)
+    v.vt_seen = v.vt_seen | process
+    v.vt_fp_hi = xp.where(process, rs.wv_fp_hi[None, :], v.vt_fp_hi)
+    v.vt_fp_lo = xp.where(process, rs.wv_fp_lo[None, :], v.vt_fp_lo)
+
+    # ---- group 6: apply decide wave B -----------------------------------
+    ncfg_hi, ncfg_lo = _apply_decides(xp, v, t, dec_b, hosts_b)
+    record_decides(dec_b, hosts_b, ncfg_hi, ncfg_lo)
+
+    # ---- group 7: phase-1a delivery -> promises -> 1b emission ----------
+    msgs = rs.w1a[:, None] & rs.w1a_bcast
+    dv = deliver(msgs, "p1a")
+    arr = dv.T                                   # [promiser, coordinator]
+    gate = (arr & ~v.stopped[:, None]
+            & _cfg_eq(rs.w1a_cfg_hi[None, :], rs.w1a_cfg_lo[None, :],
+                      v.cfg_hi[:, None], v.cfg_lo[:, None]))
+    perm1 = xp.argsort(xp.where(rs.w1a, rs.w1a_seq, I32_MAX))
+    gate_s = gate[:, perm1]
+    rank_j = rs.rank_idx[perm1]
+    above_cur = ((v.px_rnd_r[:, None] < 2)
+                 | ((v.px_rnd_r[:, None] == 2)
+                    & (v.px_rnd_i[:, None] < rank_j[None, :])))
+    arrived = xp.where(gate_s, rank_j[None, :], -1)
+    promise_s = gate_s & above_cur & (rank_j[None, :]
+                                      > _prefmax_excl(xp, arrived))
+    pr_any = promise_s.any(axis=1)
+    max_promised = xp.where(promise_s, rank_j[None, :], -1).max(axis=1)
+    v.px_rnd_r = xp.where(pr_any, 2, v.px_rnd_r)
+    v.px_rnd_i = xp.where(pr_any, max_promised, v.px_rnd_i)
+    inv1 = xp.zeros_like(perm1).at[perm1].set(jidx)
+    promise = promise_s[:, inv1]                 # back to slot coordinates
+    w1b_new = promise
+    w1b_vrnd_r_new, w1b_vrnd_i_new = v.px_vrnd_r, v.px_vrnd_i
+    w1b_fp_hi_new, w1b_fp_lo_new = v.px_vv_fp_hi, v.px_vv_fp_lo
+    w1b_set_new = v.px_vv_set
+    w1b_cfg_hi_new, w1b_cfg_lo_new = v.cfg_hi, v.cfg_lo
+    n_1b = promise.sum().astype(xp.int32)
+    phase_sent["p1b"] += n_1b
+    sent += n_1b
+
+    # ---- group 8: batch delivery -> cut aggregation -> announce ---------
+    msgs = rs.pd.any(axis=1)[:, None] & rs.pd_bcast
+    dv = deliver(msgs)
+    recv = (dv.T & ~v.stopped[:, None] & ~v.announced[:, None]
+            & _cfg_eq(rs.pd_cfg_hi[None, :], rs.pd_cfg_lo[None, :],
+                      v.cfg_hi[:, None], v.cfg_lo[:, None]))
+    onehot = (rs.pd[:, :, None] & (rs.pd_dst[:, :, None] == ridx[None, None, :]))
+    down = xp.einsum('rs,skd->rdk', recv.astype(xp.int32),
+                     onehot.astype(xp.int32)) > 0
+    gate8 = ~v.announced & ~v.stopped
+    (v.reports, v.seen_down, any_new, in_flux, crossed) = cut.receiver_aggregate(
+        xp, v.reports, v.member, v.obs_full, down, gate8, v.seen_down,
+        settings)
+    announce = (any_new & ~in_flux & crossed.any(axis=1)
+                & ~v.announced & ~v.stopped)
+    prop_fp_hi, prop_fp_lo = _proposal_fp_rows(xp, crossed, v.uid_hi, v.uid_lo)
+    v.announced = v.announced | announce
+    new_seq = t * (c + 1) + v.rx_pos
+    v.ar_seq = xp.where(announce, new_seq, v.ar_seq)
+    v.reg_valid = v.reg_valid | announce
+    v.reg_mask = xp.where(announce[:, None], crossed, v.reg_mask)
+    v.reg_fp_hi = xp.where(announce, prop_fp_hi, v.reg_fp_hi)
+    v.reg_fp_lo = xp.where(announce, prop_fp_lo, v.reg_fp_lo)
+    wv_new = announce
+    wv_fp_hi_new = xp.where(announce, prop_fp_hi, 0).astype(xp.uint32)
+    wv_fp_lo_new = xp.where(announce, prop_fp_lo, 0).astype(xp.uint32)
+    wv_cfg_hi_new, wv_cfg_lo_new = v.cfg_hi, v.cfg_lo
+    wv_seq_new = v.ar_seq
+    wv_bcast_new = v.member
+    n_fv = (announce * pop(v.member)).sum().astype(xp.int32)
+    phase_sent["fv"] += n_fv
+    sent += n_fv
+    # Seed the fast round unless classic activity already raised the rnd
+    # (the oracle's ``if not px.rnd[0] > 1`` guard in ``_propose``).
+    seed_px = announce & (v.px_rnd_r <= 1)
+    one = xp.ones_like(v.px_rnd_r)
+    v.px_rnd_r = xp.where(seed_px, one, v.px_rnd_r)
+    v.px_rnd_i = xp.where(seed_px, one, v.px_rnd_i)
+    v.px_vrnd_r = xp.where(seed_px, one, v.px_vrnd_r)
+    v.px_vrnd_i = xp.where(seed_px, one, v.px_vrnd_i)
+    v.px_vv_fp_hi = xp.where(seed_px, prop_fp_hi, v.px_vv_fp_hi)
+    v.px_vv_fp_lo = xp.where(seed_px, prop_fp_lo, v.px_vv_fp_lo)
+    v.px_vv_set = v.px_vv_set | seed_px
+    # Arm the recovery timer with the slot's next precomputed delay draw.
+    d_idx = xp.clip(v.draws, 0, N_DRAWS - 1)
+    m_idx = xp.clip(v.px_n, 0, c)
+    delay = v.delay_table[ridx, d_idx, m_idx]
+    v.flags = v.flags | xp.where((announce & (v.draws >= N_DRAWS)).any(),
+                                 FLAG_DRAWS_EXHAUSTED, 0)
+    v.px_timer = xp.where(announce, t + delay, v.px_timer)
+    v.draws = v.draws + announce.astype(xp.int32)
+    ann_cfg_hi, ann_cfg_lo = v.cfg_hi, v.cfg_lo
+    ann_prop = crossed & announce[:, None]
+
+    # ---- group 9: recovery timers fire -> 1a emission -------------------
+    fire = v.px_timer == t
+    v.px_crnd_r = xp.where(fire, 2, v.px_crnd_r)
+    v.px_timer = xp.where(fire, I32_MAX, v.px_timer)
+    w1a_new = fire
+    w1a_cfg_hi_new, w1a_cfg_lo_new = v.cfg_hi, v.cfg_lo
+    w1a_seq_new = v.ar_seq
+    w1a_bcast_new = v.member
+    n_1a = (fire * pop(v.member)).sum().astype(xp.int32)
+    phase_sent["p1a"] += n_1a
+    sent += n_1a
+
+    # ---- group 10: failure detectors ------------------------------------
+    is_fd = ((t % settings.fd_interval_ticks == 0) & (t > v.fd_gate)
+             & ~v.stopped)
+    at_thr = v.fc >= settings.fd_failure_threshold
+    probing = v.own_fd_active & ~at_thr & is_fd[:, None]
+    subj = v.own_subj
+    probe_fail = (crashed[subj] | crashed[:, None] | emat[ridx[:, None], subj])
+    probes_sent = probing.sum().astype(xp.int32)
+    probes_failed = (probing & probe_fail).sum().astype(xp.int32)
+    v.fc = xp.where(probing & probe_fail, v.fc + 1, v.fc)
+    notify_now = v.own_fd_active & at_thr & ~v.notified & is_fd[:, None]
+    v.notified = v.notified | notify_now
+    pf_new = xp.take_along_axis(notify_now, v.own_fd_first, axis=1)
+
+    # ---- group 11: batcher flush (last tick's queue -> the wire) --------
+    flush = rs.pf.any(axis=1) & ~v.stopped
+    pd_new = rs.pf & flush[:, None]
+    pd_dst_new = rs.pf_dst
+    pd_cfg_hi_new, pd_cfg_lo_new = rs.pf_cfg_hi, rs.pf_cfg_lo
+    pd_bcast_new = v.member
+    sent += (flush * pop(v.member)).sum().astype(xp.int32)
+    v.pf = pf_new
+    v.pf_dst = v.own_subj
+    v.pf_cfg_hi, v.pf_cfg_lo = v.cfg_hi, v.cfg_lo
+
+    # ---- group 12: topology rebuild after decides -----------------------
+    from rapid_tpu.engine.paxos import ring0_positions
+    from rapid_tpu.engine.topology import build_topology
+
+    def _rebuild(member):
+        topo = jax.vmap(
+            lambda m: build_topology(xp, m, rs.ring_order, rs.ring_rank))(
+                member)
+        subj_all, obs_all, _gk, fda_all, fdf_all = topo
+        pos_all = jax.vmap(
+            lambda m: ring0_positions(xp, m, rs.ring_order, rs.ring_rank))(
+                member)
+        return (obs_all, subj_all[ridx, ridx], fda_all[ridx, ridx],
+                fdf_all[ridx, ridx], pos_all[ridx, ridx])
+
+    def _keep(_member):
+        return (v.obs_full, v.own_subj, v.own_fd_active, v.own_fd_first,
+                v.rx_pos)
+
+    (v.obs_full, v.own_subj, v.own_fd_active, v.own_fd_first,
+     v.rx_pos) = lax.cond(dec_mask.any(), _rebuild, _keep, v.member)
+
+    # ---- finalize --------------------------------------------------------
+    v.tick = t
+    v.wv, v.wv_fp_hi, v.wv_fp_lo = wv_new, wv_fp_hi_new, wv_fp_lo_new
+    v.wv_cfg_hi, v.wv_cfg_lo = wv_cfg_hi_new, wv_cfg_lo_new
+    v.wv_seq, v.wv_bcast = wv_seq_new, wv_bcast_new
+    v.w1a, v.w1a_seq, v.w1a_bcast = w1a_new, w1a_seq_new, w1a_bcast_new
+    v.w1a_cfg_hi, v.w1a_cfg_lo = w1a_cfg_hi_new, w1a_cfg_lo_new
+    v.w1b = w1b_new
+    v.w1b_vrnd_r, v.w1b_vrnd_i = w1b_vrnd_r_new, w1b_vrnd_i_new
+    v.w1b_fp_hi, v.w1b_fp_lo = w1b_fp_hi_new, w1b_fp_lo_new
+    v.w1b_set = w1b_set_new
+    v.w1b_cfg_hi, v.w1b_cfg_lo = w1b_cfg_hi_new, w1b_cfg_lo_new
+    v.w2a, v.w2a_mask = w2a_new, w2a_mask_new
+    v.w2a_fp_hi, v.w2a_fp_lo = w2a_fp_hi_new, w2a_fp_lo_new
+    v.w2a_cfg_hi, v.w2a_cfg_lo = w2a_cfg_hi_new, w2a_cfg_lo_new
+    v.w2a_seq, v.w2a_bcast = w2a_seq_new, w2a_bcast_new
+    v.w2b, v.w2b_rnd = w2b_new, w2b_rnd_new
+    v.w2b_fp_hi, v.w2b_fp_lo = w2b_fp_hi_new, w2b_fp_lo_new
+    v.w2b_mask = w2b_mask_new
+    v.w2b_cfg_hi, v.w2b_cfg_lo = w2b_cfg_hi_new, w2b_cfg_lo_new
+    v.w2b_bcast = w2b_bcast_new
+    v.pd, v.pd_dst = pd_new, pd_dst_new
+    v.pd_cfg_hi, v.pd_cfg_lo = pd_cfg_hi_new, pd_cfg_lo_new
+    v.pd_bcast = pd_bcast_new
+
+    log = ReceiverStepLog(
+        tick=t,
+        sent=sent, delivered=delivered, dropped=dropped,
+        probes_sent=probes_sent, probes_failed=probes_failed,
+        fv_sent=phase_sent["fv"], fv_delivered=phase_del["fv"],
+        p1a_sent=phase_sent["p1a"], p1a_delivered=phase_del["p1a"],
+        p1b_sent=phase_sent["p1b"], p1b_delivered=phase_del["p1b"],
+        p2a_sent=phase_sent["p2a"], p2a_delivered=phase_del["p2a"],
+        p2b_sent=phase_sent["p2b"], p2b_delivered=phase_del["p2b"],
+        partitioned_edges=monitor.partitioned_edge_count(
+            xp, faults, ~crashed, t),
+        link_dropped=link_dropped,
+        announce=announce, ann_prop=ann_prop,
+        ann_cfg_hi=ann_cfg_hi, ann_cfg_lo=ann_cfg_lo,
+        decide=dec_mask, dec_hosts=dec_hosts,
+        dec_cfg_hi=dec_cfg_hi, dec_cfg_lo=dec_cfg_lo,
+        flags=v.flags,
+    )
+    nxt = ReceiverState(**{name: getattr(v, name)
+                           for name in ReceiverState._fields})
+    return nxt, log
+
+
+def init_receiver_state(uids: Sequence[int], id_fp_sum: int,
+                        settings: Settings, *, seed: int,
+                        member: Optional[Sequence[bool]] = None,
+                        ) -> ReceiverState:
+    """Boot a per-receiver universe: every slot starts with the identical
+    converged view (rows of ``member``), padding slots beyond the real
+    membership boot *stopped* (they own no protocol state). ``seed`` is
+    the schedule seed — it keys the precomputed fallback-delay table to
+    the same per-slot rng streams the host adversary draws from."""
+    from rapid_tpu.engine.paxos import (
+        build_delay_table, classic_rank_index, ring0_positions)
+    from rapid_tpu.engine.state import init_state
+    from rapid_tpu.engine.topology import build_topology
+
+    if settings.batching_window_ticks != 1:
+        raise ValueError("per-receiver mode assumes the oracle's 1-tick "
+                         "alert batching window, got "
+                         f"{settings.batching_window_ticks}")
+    base = init_state(uids, id_fp_sum, settings, member=member)
+    c, k = base.ring_order.shape
+    xp = jnp
+    member_row = base.member
+    member_cc = xp.broadcast_to(member_row[None, :], (c, c))
+    ridx = xp.arange(c, dtype=xp.int32)
+
+    subj_idx, obs_idx, _gk, fd_active, fd_first = build_topology(
+        xp, member_row, base.ring_order, base.ring_rank)
+    pos = ring0_positions(xp, member_row, base.ring_order, base.ring_rank)
+    rank_idx = classic_rank_index(xp, base.uid_hi, base.uid_lo)
+    delay_table = jnp.asarray(
+        build_delay_table(seed, c, N_DRAWS, settings))
+
+    u32z = lambda *s: xp.zeros(s, xp.uint32)
+    i32z = lambda *s: xp.zeros(s, xp.int32)
+    bz = lambda *s: xp.zeros(s, bool)
+    return ReceiverState(
+        tick=xp.int32(0),
+        uid_hi=base.uid_hi, uid_lo=base.uid_lo,
+        mfp_hi=base.mfp_hi, mfp_lo=base.mfp_lo,
+        idsum_hi=base.idsum_hi, idsum_lo=base.idsum_lo,
+        rank_idx=rank_idx,
+        ring_order=base.ring_order, ring_rank=base.ring_rank,
+        delay_table=delay_table, draws=i32z(c),
+        member=member_cc,
+        memsum_hi=xp.broadcast_to(base.memsum_hi, (c,)),
+        memsum_lo=xp.broadcast_to(base.memsum_lo, (c,)),
+        cfg_hi=xp.broadcast_to(
+            config_id_limbs(xp, base.idsum_hi, base.idsum_lo,
+                            base.memsum_hi, base.memsum_lo)[0], (c,)),
+        cfg_lo=xp.broadcast_to(
+            config_id_limbs(xp, base.idsum_hi, base.idsum_lo,
+                            base.memsum_hi, base.memsum_lo)[1], (c,)),
+        epoch=i32z(c),
+        stopped=~member_row,
+        rx_pos=xp.where(member_row, pos, I32_MAX).astype(xp.int32),
+        px_n=xp.broadcast_to(member_row.sum().astype(xp.int32), (c,)),
+        obs_full=xp.broadcast_to(obs_idx[None, :, :], (c, c, k)),
+        own_subj=subj_idx,
+        own_fd_active=fd_active & member_row[:, None],
+        own_fd_first=fd_first,
+        fc=i32z(c, k), notified=bz(c, k), fd_gate=i32z(c),
+        pf=bz(c, k), pf_dst=i32z(c, k),
+        pf_cfg_hi=u32z(c), pf_cfg_lo=u32z(c),
+        pd=bz(c, k), pd_dst=i32z(c, k),
+        pd_cfg_hi=u32z(c), pd_cfg_lo=u32z(c), pd_bcast=bz(c, c),
+        reports=bz(c, c, k), seen_down=bz(c), announced=bz(c),
+        ar_seq=xp.full((c,), I32_MAX, xp.int32),
+        reg_valid=bz(c), reg_mask=bz(c, c),
+        reg_fp_hi=u32z(c), reg_fp_lo=u32z(c),
+        wv=bz(c), wv_fp_hi=u32z(c), wv_fp_lo=u32z(c),
+        wv_cfg_hi=u32z(c), wv_cfg_lo=u32z(c),
+        wv_seq=xp.full((c,), I32_MAX, xp.int32), wv_bcast=bz(c, c),
+        vt_seen=bz(c, c), vt_fp_hi=u32z(c, c), vt_fp_lo=u32z(c, c),
+        px_rnd_r=i32z(c), px_rnd_i=i32z(c),
+        px_vrnd_r=i32z(c), px_vrnd_i=i32z(c),
+        px_vv_fp_hi=u32z(c), px_vv_fp_lo=u32z(c), px_vv_set=bz(c),
+        px_crnd_r=i32z(c), px_cval_set=bz(c),
+        px_timer=xp.full((c,), I32_MAX, xp.int32),
+        pb_seen=bz(c, c), pb_vrnd_r=i32z(c, c), pb_vrnd_i=i32z(c, c),
+        pb_fp_hi=u32z(c, c), pb_fp_lo=u32z(c, c), pb_set=bz(c, c),
+        pb_seq=i32z(c, c),
+        p2_rnd=xp.full((c,), -1, xp.int32), p2_seen=bz(c, c),
+        p2_mask=bz(c, c),
+        w1a=bz(c), w1a_cfg_hi=u32z(c), w1a_cfg_lo=u32z(c),
+        w1a_seq=xp.full((c,), I32_MAX, xp.int32), w1a_bcast=bz(c, c),
+        w1b=bz(c, c), w1b_vrnd_r=i32z(c), w1b_vrnd_i=i32z(c),
+        w1b_fp_hi=u32z(c), w1b_fp_lo=u32z(c), w1b_set=bz(c),
+        w1b_cfg_hi=u32z(c), w1b_cfg_lo=u32z(c),
+        w2a=bz(c), w2a_fp_hi=u32z(c), w2a_fp_lo=u32z(c),
+        w2a_mask=bz(c, c), w2a_cfg_hi=u32z(c), w2a_cfg_lo=u32z(c),
+        w2a_seq=xp.full((c,), I32_MAX, xp.int32), w2a_bcast=bz(c, c),
+        w2b=bz(2, c), w2b_rnd=i32z(2, c),
+        w2b_fp_hi=u32z(2, c), w2b_fp_lo=u32z(2, c), w2b_mask=bz(2, c, c),
+        w2b_cfg_hi=u32z(c), w2b_cfg_lo=u32z(c), w2b_bcast=bz(c, c),
+        flags=xp.int32(0),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _simulate(rs, faults, n_ticks: int, settings: Settings):
+    def body(carry, _):
+        return receiver_step(carry, faults, settings)
+
+    return lax.scan(body, rs, None, length=n_ticks)
+
+
+def receiver_simulate(rs: ReceiverState, faults: EngineFaults,
+                      n_ticks: int, settings: Settings):
+    """Run the jitted per-receiver scan; returns (final_state, logs)."""
+    return _simulate(rs, faults, n_ticks, settings)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _fleet_simulate(rs, faults, n_ticks: int, settings: Settings):
+    return jax.vmap(lambda s, f: _simulate(s, f, n_ticks, settings))(
+        rs, faults)
+
+
+def receiver_fleet_simulate(stacked_rs, stacked_faults, n_ticks: int,
+                            settings: Settings):
+    """vmap the per-receiver scan over a leading fleet axis (the tick body
+    traces once regardless of F, like the shared fleet path)."""
+    return _fleet_simulate(stacked_rs, stacked_faults, n_ticks, settings)
+
+
+# --- host-side extraction ------------------------------------------------
+
+def receiver_events(logs) -> List[List[Tuple[int, str, int, Tuple[int, ...]]]]:
+    """Per-slot ``(tick, kind, config_id, slots)`` event streams in
+    ``AdversaryRun.events_by_slot`` format (a slot announces at most once
+    and decides at most once per tick, and never both, so tick order is
+    total per slot)."""
+    ann = np.asarray(logs.announce)
+    dec = np.asarray(logs.decide)
+    ann_prop = np.asarray(logs.ann_prop)
+    dec_hosts = np.asarray(logs.dec_hosts)
+    ann_cfg = (np.asarray(logs.ann_cfg_hi).astype(np.uint64) << 32) \
+        | np.asarray(logs.ann_cfg_lo).astype(np.uint64)
+    dec_cfg = (np.asarray(logs.dec_cfg_hi).astype(np.uint64) << 32) \
+        | np.asarray(logs.dec_cfg_lo).astype(np.uint64)
+    ticks = np.asarray(logs.tick)
+    n_ticks, c = ann.shape
+    events: List[List[Tuple[int, str, int, Tuple[int, ...]]]] = [
+        [] for _ in range(c)]
+    for ti in range(n_ticks):
+        t = int(ticks[ti])
+        for r in np.nonzero(dec[ti])[0]:
+            events[int(r)].append(
+                (t, "view_change", int(dec_cfg[ti, r]),
+                 tuple(int(s) for s in np.nonzero(dec_hosts[ti, r])[0])))
+        for r in np.nonzero(ann[ti])[0]:
+            events[int(r)].append(
+                (t, "proposal", int(ann_cfg[ti, r]),
+                 tuple(int(s) for s in np.nonzero(ann_prop[ti, r])[0])))
+    return events
+
+
+def receiver_counters(logs) -> List[dict]:
+    """Per-tick counter deltas, ``AdversaryRun.tick_history`` format."""
+    fields = {"sent": logs.sent, "delivered": logs.delivered,
+              "dropped": logs.dropped, "probes_sent": logs.probes_sent,
+              "probes_failed": logs.probes_failed}
+    arrs = {k: np.asarray(a) for k, a in fields.items()}
+    n_ticks = arrs["sent"].shape[0]
+    return [{"sent": int(arrs["sent"][i]),
+             "delivered": int(arrs["delivered"][i]),
+             "dropped": int(arrs["dropped"][i]),
+             "timeouts": 0,
+             "probes_sent": int(arrs["probes_sent"][i]),
+             "probes_failed": int(arrs["probes_failed"][i])}
+            for i in range(n_ticks)]
+
+
+def receiver_phase_counters(logs) -> List[dict]:
+    """Per-tick phase deltas, ``AdversaryRun.phase_history`` format."""
+    pairs = (("fast_vote", logs.fv_sent, logs.fv_delivered),
+             ("phase1a", logs.p1a_sent, logs.p1a_delivered),
+             ("phase1b", logs.p1b_sent, logs.p1b_delivered),
+             ("phase2a", logs.p2a_sent, logs.p2a_delivered),
+             ("phase2b", logs.p2b_sent, logs.p2b_delivered))
+    arrs = [(p, np.asarray(s), np.asarray(d)) for p, s, d in pairs]
+    n_ticks = arrs[0][1].shape[0]
+    return [{f"{p}_{kind}": int(a[i]) for p, s, d in arrs
+             for kind, a in (("sent", s), ("delivered", d))}
+            for i in range(n_ticks)]
+
+
+def receiver_config_ids(rs: ReceiverState) -> List[int]:
+    """Final per-slot configuration ids as python ints."""
+    hi = np.asarray(rs.cfg_hi).astype(np.uint64)
+    lo = np.asarray(rs.cfg_lo).astype(np.uint64)
+    return [int(h << 32 | l) for h, l in zip(hi, lo)]
+
+
+def receiver_run_payload(rs: ReceiverState, logs, n: int, n_ticks: int):
+    """Bundle a finished device run into an ``AdversaryRun`` so existing
+    diff/metrics tooling consumes it unchanged."""
+    from rapid_tpu.engine.adversary import AdversaryRun
+
+    events = receiver_events(logs)
+    counters = receiver_counters(logs)
+    phases = receiver_phase_counters(logs)
+    member = np.asarray(rs.member)
+    totals = {k: sum(row[k] for row in counters)
+              for k in ("sent", "delivered", "dropped", "probes_sent",
+                        "probes_failed")}
+    totals["timeouts"] = 0
+    phase_totals = {k: sum(row[k] for row in phases) for k in phases[0]} \
+        if phases else {}
+    return AdversaryRun(
+        n=n, n_ticks=n_ticks,
+        events_by_slot=[events[s] for s in range(n)],
+        tick_history=counters,
+        phase_history=phases,
+        partitioned_edges=[int(x) for x in np.asarray(logs.partitioned_edges)],
+        link_dropped=[int(x) for x in np.asarray(logs.link_dropped)],
+        config_ids=receiver_config_ids(rs)[:n],
+        members_by_slot=[frozenset(int(i) for i in np.nonzero(member[s])[0])
+                         for s in range(n)],
+        stopped=[bool(x) for x in np.asarray(rs.stopped)[:n]],
+        totals=totals, phase_totals=phase_totals,
+    )
+
+
+# --- memory sizing -------------------------------------------------------
+
+def receiver_field_shapes(capacity: int, k: int, n_draws: int = N_DRAWS):
+    """``{field: (shape, itemsize)}`` for every ``ReceiverState`` leaf —
+    the sizing ground truth (``tests/test_receiver.py`` pins each entry
+    against a real instantiation so the table cannot drift)."""
+    c = capacity
+    B, I, U = 1, 4, 4          # bool, int32, uint32 itemsizes
+    s = {"tick": ((), I), "flags": ((), I),
+         "idsum_hi": ((), U), "idsum_lo": ((), U),
+         "delay_table": ((c, n_draws, c + 1), I),
+         "ring_order": ((c, k), I), "ring_rank": ((c, k), I),
+         "obs_full": ((c, c, k), I), "reports": ((c, c, k), B),
+         "own_subj": ((c, k), I), "own_fd_first": ((c, k), I),
+         "own_fd_active": ((c, k), B), "fc": ((c, k), I),
+         "notified": ((c, k), B), "pf": ((c, k), B),
+         "pf_dst": ((c, k), I), "pd": ((c, k), B), "pd_dst": ((c, k), I),
+         "w2b": ((2, c), B), "w2b_rnd": ((2, c), I),
+         "w2b_fp_hi": ((2, c), U), "w2b_fp_lo": ((2, c), U),
+         "w2b_mask": ((2, c, c), B)}
+    for f in ("uid_hi", "uid_lo", "mfp_hi", "mfp_lo", "memsum_hi",
+              "memsum_lo", "cfg_hi", "cfg_lo", "pf_cfg_hi", "pf_cfg_lo",
+              "pd_cfg_hi", "pd_cfg_lo", "reg_fp_hi", "reg_fp_lo",
+              "wv_fp_hi", "wv_fp_lo", "wv_cfg_hi", "wv_cfg_lo",
+              "px_vv_fp_hi", "px_vv_fp_lo", "w1a_cfg_hi", "w1a_cfg_lo",
+              "w1b_fp_hi", "w1b_fp_lo", "w1b_cfg_hi", "w1b_cfg_lo",
+              "w2a_fp_hi", "w2a_fp_lo", "w2a_cfg_hi", "w2a_cfg_lo",
+              "w2b_cfg_hi", "w2b_cfg_lo"):
+        s[f] = ((c,), U)
+    for f in ("rank_idx", "draws", "epoch", "rx_pos", "px_n", "fd_gate",
+              "ar_seq", "wv_seq", "px_rnd_r", "px_rnd_i", "px_vrnd_r",
+              "px_vrnd_i", "px_crnd_r", "px_timer", "p2_rnd", "w1a_seq",
+              "w1b_vrnd_r", "w1b_vrnd_i", "w2a_seq"):
+        s[f] = ((c,), I)
+    for f in ("stopped", "seen_down", "announced", "reg_valid", "wv",
+              "px_vv_set", "px_cval_set", "w1a", "w2a", "w1b_set"):
+        s[f] = ((c,), B)
+    for f in ("member", "pd_bcast", "reg_mask", "wv_bcast", "vt_seen",
+              "pb_seen", "pb_set", "p2_seen", "p2_mask", "w1a_bcast",
+              "w1b", "w2a_mask", "w2a_bcast", "w2b_bcast"):
+        s[f] = ((c, c), B)
+    for f in ("vt_fp_hi", "vt_fp_lo", "pb_fp_hi", "pb_fp_lo"):
+        s[f] = ((c, c), U)
+    for f in ("pb_vrnd_r", "pb_vrnd_i", "pb_seq"):
+        s[f] = ((c, c), I)
+    assert set(s) == set(ReceiverState._fields), \
+        sorted(set(s) ^ set(ReceiverState._fields))
+    return s
+
+
+def receiver_state_bytes(capacity: int, k: int,
+                         n_draws: int = N_DRAWS) -> int:
+    """Exact per-member footprint of one ``ReceiverState`` in bytes."""
+    return sum(int(np.prod(shape, dtype=np.int64)) * item
+               for shape, item in
+               receiver_field_shapes(capacity, k, n_draws).values())
+
+
+def receiver_log_bytes(capacity: int, n_ticks: int) -> int:
+    """Per-member log footprint for ``n_ticks`` scanned ticks."""
+    c = capacity
+    per_tick = (18 * 4            # scalar i32 counters/gauges
+                + 2 * c + 2 * c * c          # announce/decide masks
+                + 4 * c * 4)      # cfg limb columns
+    return per_tick * n_ticks

@@ -172,6 +172,47 @@ def _infer_capacity(leaves) -> int:
     return max(dims)
 
 
+def fleet_spec_for(shape: Sequence[int], capacity: int, mesh):
+    """``spec_for`` for leaves that carry a leading *fleet* axis.
+
+    Fleet-stacked pytrees (``fleet.stack_receiver_members``) prepend an
+    ``F`` axis to every leaf: ``[F, C, C]`` report matrices,
+    ``[F, C, C, K]`` observer tables, ``[F, W, C]`` window masks. Axis 0
+    is the vmapped member dimension and must stay replicated — when
+    ``F == C`` (an 8-member fleet of 8-slot clusters, or any fleet sized
+    to its capacity) ``spec_for`` would otherwise shard the fleet axis
+    itself. This wrapper skips axis 0 and shards the first *later*
+    capacity-sized axis that divides the mesh: ``[F, C, C]`` leaves get
+    ``P(None, "slots")`` (trailing axes replicated), scalars-per-member
+    ``[F]`` and non-dividing axes replicate under the same divisibility
+    guard as ``spec_for``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = mesh_size(mesh)
+    for axis, dim in enumerate(shape):
+        if axis == 0:
+            continue
+        if dim == capacity and capacity % n_dev == 0:
+            return P(*([None] * axis + [AXIS]))
+    return P()
+
+
+def fleet_shard_put(tree, mesh, capacity: int):
+    """``device_put`` a fleet-stacked pytree under ``fleet_spec_for``.
+
+    The explicit ``capacity`` (not inferred) keeps an ``F >= C`` fleet
+    from being mistaken for the slot universe."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, fleet_spec_for(jax.numpy.shape(x),
+                                                  capacity, mesh))),
+        tree)
+
+
 def state_shardings(state, mesh):
     """Per-leaf ``NamedSharding`` pytree for an ``EngineState`` (or any
     slot-universe pytree) — usable as jit ``in_shardings``/

@@ -45,7 +45,10 @@ intervals — are handled *exactly* by the per-receiver adversary engine
 which replicates detector/consensus state per node. The shared step still
 applies link-window masks to its failure-detector probes (``EngineFaults``
 link fields below), so link faults perturb monitoring at benchmark scale,
-but its shared cut state remains an approximation for them. The churn
+but its shared cut state remains an approximation for them. For *on
+device* exactness under link faults, ``ReceiverState`` (below) +
+``rapid_tpu.engine.receiver`` replicate view state per receiver — the
+memory-heavy mode fleet lowering selects per member kind. The churn
 envelope (what join/leave schedules the shared state reproduces exactly)
 is documented in ``rapid_tpu.engine.churn``.
 """
@@ -276,6 +279,190 @@ class StepLog(NamedTuple):
     # --- on-device invariant monitor (rapid_tpu.engine.invariants) ------
     inv_bits: object                  # i32: violation bitmask (0 = clean;
                                       # constant 0 when the monitor is off)
+
+
+class ReceiverState(NamedTuple):
+    """Per-receiver protocol state: every slot carries its *own* view.
+
+    The shared-state ``EngineState`` stands in for all N per-node detector
+    and consensus copies — exact for crash faults, an approximation for
+    link faults (see the module docstring). ``ReceiverState`` replicates
+    the view-dependent state per receiver: ``member``/``reports``/topology
+    become ``[C, C(, K)]`` with axis 0 the *receiver* slot, and the wire
+    is explicit (one in-flight buffer per message kind with the sender's
+    cfg/bcast snapshot), so ``LinkWindow`` reachability is evaluated at
+    delivery per (sender, receiver) edge — bit-exact against
+    ``engine.adversary`` for link-fault scenarios. Memory is quadratic by
+    design; ``engine.receiver.receiver_state_bytes`` sizes it and
+    ``Settings.receiver_capacity_cap`` bounds it.
+
+    Naming: ``rx_*``/``own_*`` are per-receiver-diagonal quantities (the
+    slot's own row in its own view), ``w*`` are wire buffers (sent last
+    tick, delivered next), ``pf``/``pd`` the alert batcher pipeline
+    (pending-flush / in-flight), ``pb``/``p2`` the phase-1b / phase-2b
+    stores of a slot acting as coordinator / listener.
+    """
+
+    tick: object            # i32
+    # --- identity (replicated statics) -------------------------------
+    uid_hi: object          # u32 [C]
+    uid_lo: object          # u32 [C]
+    mfp_hi: object          # u32 [C] membership fingerprints
+    mfp_lo: object          # u32 [C]
+    idsum_hi: object        # u32 scalar
+    idsum_lo: object        # u32 scalar
+    rank_idx: object        # i32 [C] classic-Paxos rank index per slot
+    ring_order: object      # i32 [C, K] static boot ring order
+    ring_rank: object       # i32 [C, K]
+    delay_table: object     # i32 [C, D, C+1] precomputed fallback delays
+    draws: object           # i32 [C] fallback-delay draws consumed
+    # --- per-receiver view -------------------------------------------
+    member: object          # bool [C, C]: row r = r's membership view
+    memsum_hi: object       # u32 [C]
+    memsum_lo: object       # u32 [C]
+    cfg_hi: object          # u32 [C] configuration id per receiver
+    cfg_lo: object          # u32 [C]
+    epoch: object           # i32 [C]
+    stopped: object         # bool [C]: r decided itself out of the view
+    rx_pos: object          # i32 [C]: r's ring-0 position in its own view
+    px_n: object            # i32 [C]: r's paxos instance size
+    # --- per-receiver topology ---------------------------------------
+    obs_full: object        # i32 [C, C, K]: observer table in r's view
+    own_subj: object        # i32 [C, K]: r's own ring subjects
+    own_fd_active: object   # bool [C, K]
+    own_fd_first: object    # i32 [C, K]
+    # --- failure detectors -------------------------------------------
+    fc: object              # i32 [C, K] tombstone counters
+    notified: object        # bool [C, K]
+    fd_gate: object         # i32 [C]: FD jobs fire at t % I == 0, t > gate
+    # --- alert batcher pipeline --------------------------------------
+    pf: object              # bool [C, K]: enqueued this tick (flush next)
+    pf_dst: object          # i32 [C, K]
+    pf_cfg_hi: object       # u32 [C] cfg stamp at enqueue
+    pf_cfg_lo: object       # u32 [C]
+    pd: object              # bool [C, K]: batch in flight (deliver next)
+    pd_dst: object          # i32 [C, K]
+    pd_cfg_hi: object       # u32 [C]
+    pd_cfg_lo: object       # u32 [C]
+    pd_bcast: object        # bool [C, C] recipient snapshot at flush
+    # --- cut detector ------------------------------------------------
+    reports: object         # bool [C, C, K] (receiver, dst, ring)
+    seen_down: object       # bool [C]
+    announced: object       # bool [C]
+    ar_seq: object          # i32 [C]: announce order key t*(C+1)+rx_pos
+    # --- proposal registry (never cleared; fp -> member mask) --------
+    reg_valid: object       # bool [C]
+    reg_mask: object        # bool [C, C] announced proposal of slot r
+    reg_fp_hi: object       # u32 [C]
+    reg_fp_lo: object       # u32 [C]
+    # --- fast-round votes --------------------------------------------
+    wv: object              # bool [C] vote wire (sender-indexed)
+    wv_fp_hi: object        # u32 [C]
+    wv_fp_lo: object        # u32 [C]
+    wv_cfg_hi: object       # u32 [C]
+    wv_cfg_lo: object       # u32 [C]
+    wv_seq: object          # i32 [C] sender announce-order key
+    wv_bcast: object        # bool [C, C]
+    vt_seen: object         # bool [C, C] (receiver, voter)
+    vt_fp_hi: object        # u32 [C, C]
+    vt_fp_lo: object        # u32 [C, C]
+    # --- classic-Paxos per-receiver instance -------------------------
+    px_rnd_r: object        # i32 [C]
+    px_rnd_i: object        # i32 [C]
+    px_vrnd_r: object       # i32 [C]
+    px_vrnd_i: object       # i32 [C]
+    px_vv_fp_hi: object     # u32 [C] accepted value fingerprint
+    px_vv_fp_lo: object     # u32 [C]
+    px_vv_set: object       # bool [C]
+    px_crnd_r: object       # i32 [C] (crnd index is rank_idx when set)
+    px_cval_set: object     # bool [C]
+    px_timer: object        # i32 [C] absolute fire tick, I32_MAX idle
+    # --- phase-1b store (coordinator, promiser) ----------------------
+    pb_seen: object         # bool [C, C]
+    pb_vrnd_r: object       # i32 [C, C]
+    pb_vrnd_i: object       # i32 [C, C]
+    pb_fp_hi: object        # u32 [C, C]
+    pb_fp_lo: object        # u32 [C, C]
+    pb_set: object          # bool [C, C] vval non-empty
+    pb_seq: object          # i32 [C, C] arrival key t*(C+1)+rx_pos(promiser)
+    # --- phase-2b store (listener, acceptor), single tracked round ---
+    p2_rnd: object          # i32 [C] rank index of tracked round, -1 none
+    p2_seen: object         # bool [C, C]
+    p2_mask: object         # bool [C, C] decide contents (member mask)
+    # --- wires: phase 1a ---------------------------------------------
+    w1a: object             # bool [C] (coordinator-indexed)
+    w1a_cfg_hi: object      # u32 [C]
+    w1a_cfg_lo: object      # u32 [C]
+    w1a_seq: object         # i32 [C]
+    w1a_bcast: object       # bool [C, C]
+    # --- wires: phase 1b (promiser, coordinator) ---------------------
+    w1b: object             # bool [C, C]
+    w1b_vrnd_r: object      # i32 [C] payload per promiser
+    w1b_vrnd_i: object      # i32 [C]
+    w1b_fp_hi: object       # u32 [C]
+    w1b_fp_lo: object       # u32 [C]
+    w1b_set: object         # bool [C]
+    w1b_cfg_hi: object      # u32 [C]
+    w1b_cfg_lo: object      # u32 [C]
+    # --- wires: phase 2a ---------------------------------------------
+    w2a: object             # bool [C] (coordinator-indexed)
+    w2a_fp_hi: object       # u32 [C]
+    w2a_fp_lo: object       # u32 [C]
+    w2a_mask: object        # bool [C, C] resolved proposal on the wire
+    w2a_cfg_hi: object      # u32 [C]
+    w2a_cfg_lo: object      # u32 [C]
+    w2a_seq: object         # i32 [C]
+    w2a_bcast: object       # bool [C, C]
+    # --- wires: phase 2b, up to 2 accepts per acceptor per tick ------
+    w2b: object             # bool [2, C] (slot, acceptor)
+    w2b_rnd: object         # i32 [2, C] rank index of accepted round
+    w2b_fp_hi: object       # u32 [2, C]
+    w2b_fp_lo: object       # u32 [2, C]
+    w2b_mask: object        # bool [2, C, C]
+    w2b_cfg_hi: object      # u32 [C] one snapshot per acceptor
+    w2b_cfg_lo: object      # u32 [C]
+    w2b_bcast: object       # bool [C, C]
+    # --- envelope / error flags (sticky bitmask, see receiver.FLAGS) --
+    flags: object           # i32 scalar
+
+
+class ReceiverStepLog(NamedTuple):
+    """Per-tick outputs of the per-receiver step.
+
+    Unlike ``StepLog`` these are exact on-device counter *values* (the
+    per-receiver wire makes sender x recipient products cheap and int32-
+    safe at per-receiver scales), matching ``AdversaryRun`` tick rows
+    field for field; event masks carry per-slot announce/decide streams
+    for ``diff.run_receiver_differential``.
+    """
+
+    tick: object            # i32
+    sent: object            # i32
+    delivered: object       # i32
+    dropped: object         # i32
+    probes_sent: object     # i32
+    probes_failed: object   # i32
+    fv_sent: object         # i32 per-phase pairs, oracle _PHASE_OF order
+    fv_delivered: object    # i32
+    p1a_sent: object        # i32
+    p1a_delivered: object   # i32
+    p1b_sent: object        # i32
+    p1b_delivered: object   # i32
+    p2a_sent: object        # i32
+    p2a_delivered: object   # i32
+    p2b_sent: object        # i32
+    p2b_delivered: object   # i32
+    partitioned_edges: object   # i32 (over non-crashed slots, per window)
+    link_dropped: object    # i32
+    announce: object        # bool [C] slot announced its proposal this tick
+    ann_prop: object        # bool [C, C] the announced proposal masks
+    ann_cfg_hi: object      # u32 [C] cfg at announce (pre-decide)
+    ann_cfg_lo: object      # u32 [C]
+    decide: object          # bool [C] slot decided a view change this tick
+    dec_hosts: object       # bool [C, C] removed hosts
+    dec_cfg_hi: object      # u32 [C] cfg after the decide
+    dec_cfg_lo: object      # u32 [C]
+    flags: object           # i32 sticky envelope/error bitmask snapshot
 
 
 def config_id_limbs(xp, idsum_hi, idsum_lo, memsum_hi, memsum_lo):

@@ -405,11 +405,41 @@ def validate_schedule(schedule: AdversarySchedule) -> None:
         if tick < 1:
             raise ValueError(f"crash tick {tick} must be >= 1")
     for w in schedule.windows:
+        if not w.src_slots or not w.dst_slots:
+            raise ValueError("window src_slots/dst_slots must be non-empty")
         for s in w.src_slots | w.dst_slots:
             if not 0 <= s < n:
                 raise ValueError(f"window slot {s} outside universe of {n}")
         if w.period_ticks < 0:
             raise ValueError("window period_ticks must be >= 0")
+        if w.start_tick >= w.end_tick:
+            raise ValueError(
+                f"zero-length window: start_tick {w.start_tick} >= "
+                f"end_tick {w.end_tick}")
+    # Two *static* (period 0) windows may not both cover the same
+    # directed edge in overlapping tick ranges: the duplicate edge rule
+    # is at best redundant and at worst a half-healed partition the
+    # author didn't mean (flip-flop windows are exempt — phase offsets
+    # make simultaneous coverage intentional there).
+    static = [w for w in schedule.windows if w.period_ticks == 0]
+    for i, a in enumerate(static):
+        for b in static[i + 1:]:
+            if a.start_tick >= b.end_tick or b.start_tick >= a.end_tick:
+                continue
+            a_dirs = [(a.src_slots, a.dst_slots)] + (
+                [(a.dst_slots, a.src_slots)] if a.two_way else [])
+            b_dirs = [(b.src_slots, b.dst_slots)] + (
+                [(b.dst_slots, b.src_slots)] if b.two_way else [])
+            for asrc, adst in a_dirs:
+                for bsrc, bdst in b_dirs:
+                    if (asrc & bsrc) and (adst & bdst):
+                        s = min(asrc & bsrc)
+                        d = min(adst & bdst)
+                        raise ValueError(
+                            f"overlapping static windows cover directed "
+                            f"edge {s}->{d} in ticks "
+                            f"[{max(a.start_tick, b.start_tick)}, "
+                            f"{min(a.end_tick, b.end_tick)})")
     per_slot: Dict[int, int] = {}
     seen: Set[Tuple[int, int]] = set()
     for p in schedule.proposes:

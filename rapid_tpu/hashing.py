@@ -135,6 +135,30 @@ def sum64(xp, hi, lo):
     return hi[0], lo[0]
 
 
+def sum64_axis(xp, hi, lo):
+    """Sum (hi, lo) uint64 limb arrays mod 2^64 along the LAST axis.
+
+    Batched companion to ``sum64``: leading axes are preserved, so a
+    ``[C, C]`` limb matrix reduces to per-row ``[C]`` sums with carries
+    intact.  Same pairwise log-fold, same jit-friendliness.
+    """
+    hi = hi.astype(xp.uint32)
+    lo = lo.astype(xp.uint32)
+    n = hi.shape[-1]
+    if n == 0:
+        shape = hi.shape[:-1]
+        return xp.zeros(shape, xp.uint32), xp.zeros(shape, xp.uint32)
+    while n > 1:
+        if n % 2:
+            pad = [(0, 0)] * (hi.ndim - 1) + [(0, 1)]
+            hi = xp.pad(hi, pad)
+            lo = xp.pad(lo, pad)
+            n += 1
+        hi, lo = add64(xp, hi[..., 0::2], lo[..., 0::2], hi[..., 1::2], lo[..., 1::2])
+        n //= 2
+    return hi[..., 0], lo[..., 0]
+
+
 def shr64(xp, hi, lo, n: int):
     """Logical right shift by constant 0 < n < 64."""
     assert 0 < n < 64

@@ -52,6 +52,15 @@ class Settings:
     max_cut_size: int = 64      # max nodes per view-change proposal
     max_active_dsts: int = 128  # alert destinations tracked per config
 
+    # --- per-receiver link-fault mode (rapid_tpu.engine.receiver) ---
+    # Hard cap on the slot capacity a per-receiver fleet member may boot
+    # with. The per-receiver state is quadratic per member ([C, C, K]
+    # report/topology tensors — ``receiver.receiver_state_bytes`` sizes
+    # it exactly), so campaigns refuse oversized fleets up front with a
+    # structured ``fleet.ReceiverBudgetError`` instead of letting the
+    # device OOM mid-campaign.
+    receiver_capacity_cap: int = 1024
+
     # --- observability (rapid_tpu.engine.invariants) ---
     # Compile the on-device protocol invariant monitor into the jitted
     # step. Static: flipping it retraces; False compiles the checks out

@@ -371,18 +371,75 @@ def multichip_comparison(sizes: Sequence[int], settings,
             "kernels": entries}
 
 
+def receiver_memory_block(settings, n: int = 64,
+                          fleet_sizes: Sequence[int] = (4, 64),
+                          seed: int = 0) -> Dict[str, object]:
+    """Measured memory footprint of the per-receiver fleet step.
+
+    AOT-lowers one vmapped ``engine.receiver.receiver_step`` tick per
+    fleet size over a representative partition member (a forced
+    one-way-split draw from ``sample_adversary_schedule``) and reads
+    XLA's ``memory_analysis`` — the numbers that justify
+    ``Settings.receiver_capacity_cap`` and that campaigns echo in their
+    ``per_receiver`` payload block. ``member_state_bytes`` is the
+    analytic per-member figure (``receiver.receiver_state_bytes``) the
+    measured argument bytes should roughly ``F``-multiply.
+    """
+    import jax
+
+    from rapid_tpu.engine import receiver as receiver_mod
+    from rapid_tpu.engine.fleet import (lower_receiver_schedule,
+                                        stack_receiver_members)
+    from rapid_tpu.faults import ScenarioWeights, sample_adversary_schedule
+
+    weights = ScenarioWeights(crash=0.0, partition=1.0, flip_flop=0.0,
+                              contested=0.0, churn=0.0)
+    sc = sample_adversary_schedule(n, seed, 8 * settings.fd_interval_ticks,
+                                   weights)
+    member = lower_receiver_schedule(sc.schedule, settings,
+                                     fleet_size=max(fleet_sizes))
+    c = int(member.state.member.shape[0])
+
+    def one_tick(state, faults):
+        return receiver_mod.receiver_step(state, faults, settings)
+
+    fleets: List[Dict[str, object]] = []
+    for f in fleet_sizes:
+        fleet = stack_receiver_members([member] * f)
+        t0 = time.perf_counter()
+        compiled = jax.jit(jax.vmap(one_tick)).lower(
+            fleet.state, fleet.faults).compile()
+        compile_s = time.perf_counter() - t0
+        mem = _memory_stats(compiled)
+        fleets.append({"fleet_size": f, **mem,
+                       "compile_s": round(compile_s, 6)})
+    return {
+        "n": n,
+        "capacity": c,
+        "k": settings.K,
+        "member_state_bytes": receiver_mod.receiver_state_bytes(
+            c, settings.K),
+        "fleets": fleets,
+    }
+
+
 def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
                      seed: int = 0, warmup_ticks: int = 8,
                      include_fallback: bool = True,
                      multichip: bool = True,
-                     multichip_devices: int = 8) -> Dict[str, object]:
+                     multichip_devices: int = 8,
+                     receiver_memory: bool = True,
+                     receiver_n: int = 64) -> Dict[str, object]:
     """The ``--profile-sweep`` artifact: per-N kernel costs plus the
     wall-clock-dominant kernel per N (the pjit-sharding gate input).
 
     When ``multichip`` is on and enough devices exist, the payload also
     carries a ``multichip`` block with sharded-vs-single-device wall
     medians for the dominant kernels; otherwise the key is ``null`` so
-    consumers can tell "not measured" from "not present".
+    consumers can tell "not measured" from "not present". The
+    ``receiver_memory`` block (same null-when-skipped convention) sizes
+    the per-receiver fleet step at small and campaign-scale fleet
+    widths.
     """
     import jax
 
@@ -402,6 +459,9 @@ def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
         "multichip": multichip_comparison(
             sizes, settings, n_devices=multichip_devices, repeats=repeats,
             seed=seed, warmup_ticks=warmup_ticks) if multichip else None,
+        "receiver_memory": receiver_memory_block(
+            settings, n=receiver_n, seed=seed) if receiver_memory
+        else None,
     }
 
 
@@ -420,6 +480,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="skip the classic-Paxos phase kernels")
     parser.add_argument("--no-multichip", action="store_true",
                         help="skip the sharded-vs-single-device block")
+    parser.add_argument("--no-receiver-memory", action="store_true",
+                        help="skip the per-receiver fleet memory block")
+    parser.add_argument("--receiver-n", type=int, default=64,
+                        help="cluster size for the per-receiver memory "
+                             "block (default 64)")
     parser.add_argument("--multichip-devices", type=int, default=8,
                         help="mesh width for the multichip block "
                              "(default 8; needs that many jax devices)")
@@ -447,7 +512,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                               include_fallback=not args.no_fallback,
                               multichip=(not args.no_multichip
                                          and args.merge_multichip is None),
-                              multichip_devices=args.multichip_devices)
+                              multichip_devices=args.multichip_devices,
+                              receiver_memory=not args.no_receiver_memory,
+                              receiver_n=args.receiver_n)
     if args.merge_multichip is not None:
         with open(args.merge_multichip) as fh:
             report["multichip"] = json.load(fh).get("multichip")

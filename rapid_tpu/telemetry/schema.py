@@ -25,7 +25,7 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 #: Fold semantics of every RunSummary gauge when aggregated over a fleet
 #: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
@@ -147,6 +147,27 @@ MULTICHIP_ENTRY_SPEC = {
     "speedup": (int, float, type(None)),
 }
 
+#: Per-receiver fleet-step memory block of the dominance report
+#: (``rapid_tpu.telemetry.profile.receiver_memory_block``). Like
+#: ``multichip``, the top-level ``receiver_memory`` key may be ``null``
+#: ("not measured"); when present it must carry these fields.
+RECEIVER_MEMORY_SPEC = {
+    "n": (int,),
+    "capacity": (int,),
+    "k": (int,),
+    "member_state_bytes": (int,),
+    "fleets": (list,),
+}
+
+RECEIVER_FLEET_ENTRY_SPEC = {
+    "fleet_size": (int,),
+    "argument_bytes": (int,),
+    "output_bytes": (int,),
+    "temp_bytes": (int,),
+    "peak_bytes": (int,),
+    "compile_s": _NUM,
+}
+
 
 #: Fleet-campaign block embedded in a fleet run payload under
 #: ``"campaign"`` (``rapid_tpu.campaign.run_campaign``).
@@ -156,15 +177,44 @@ CAMPAIGN_SPEC = {
     "fleet_size": (int,),
     "dispatches": (int,),
     "scenario_kinds": (dict,),
+    "per_receiver": (dict,),
     "spot_checks": (dict,),
     "distributions": (dict,),
+}
+
+#: Per-receiver dispatch block of a campaign payload (schema v4): how
+#: many members ran device-exact under link faults and the measured
+#: quadratic budget that gated them (``receiver.receiver_state_bytes``).
+PER_RECEIVER_SPEC = {
+    "enabled": (bool,),
+    "members": (int,),
+    "dispatches": (int,),
+    "fleet_size": (int,),
+    "capacity": (int,),
+    "capacity_cap": (int,),
+    "member_state_bytes": (int,),
+    "kinds": (dict,),
 }
 
 SPOT_CHECK_SPEC = {
     "requested": (int,),
     "run": (int,),
     "passed": (int,),
+    "failed": (int,),
+    "max_failures": (int,),
     "members": (list,),
+}
+
+#: One spot-check member record (schema v4 adds the graceful-degradation
+#: fields: mode, pass/fail, forensics artifact path, first-line error).
+SPOT_MEMBER_SPEC = {
+    "member": (int,),
+    "kind": (str,),
+    "seed": (int,),
+    "mode": (str,),
+    "passed": (bool,),
+    "artifact": (str, type(None)),
+    "error": (str, type(None)),
 }
 
 #: One nearest-rank distribution block (``metrics.summary_distributions``).
@@ -221,9 +271,15 @@ def validate_campaign(block, where: str = "campaign") -> List[str]:
             if not isinstance(count, int) or isinstance(count, bool):
                 errors.append(f"{where}.scenario_kinds.{kind}: expected "
                               f"int, got {type(count).__name__}")
+    if isinstance(block.get("per_receiver"), dict):
+        errors += _check(block["per_receiver"], PER_RECEIVER_SPEC,
+                         f"{where}.per_receiver")
     if isinstance(block.get("spot_checks"), dict):
         errors += _check(block["spot_checks"], SPOT_CHECK_SPEC,
                          f"{where}.spot_checks")
+        for i, m in enumerate(block["spot_checks"].get("members") or []):
+            errors += _check(m, SPOT_MEMBER_SPEC,
+                             f"{where}.spot_checks.members[{i}]")
     dists = block.get("distributions")
     if isinstance(dists, dict):
         for key in CAMPAIGN_DISTRIBUTIONS:
@@ -280,6 +336,14 @@ def validate_profile_payload(payload, where: str = "payload") -> List[str]:
             for j, entry in enumerate(mc.get("kernels") or []):
                 errors += _check(entry, MULTICHIP_ENTRY_SPEC,
                                  f"{where}.multichip.kernels[{j}]")
+    rm = payload.get("receiver_memory")
+    if rm is not None:  # null means "not measured", which is valid
+        errors += _check(rm, RECEIVER_MEMORY_SPEC,
+                         f"{where}.receiver_memory")
+        if isinstance(rm, dict):
+            for j, entry in enumerate(rm.get("fleets") or []):
+                errors += _check(entry, RECEIVER_FLEET_ENTRY_SPEC,
+                                 f"{where}.receiver_memory.fleets[{j}]")
     return errors
 
 

@@ -182,6 +182,46 @@ def compare_profile_sweeps(current: Dict, baseline: Dict,
                     f"payload.multichip.{key}: config mismatch (current "
                     f"{cur_mc.get(key)!r} vs baseline {base_mc.get(key)!r})"
                     f" — regenerate with --update-baseline")
+
+    # Receiver-memory block: same null-tolerance as multichip (a smoke
+    # profile may skip it with --no-receiver-memory while the committed
+    # sweep carries it). The config row and the per-member byte figure
+    # are pure shape arithmetic, so they diff exactly; XLA's temp/peak
+    # estimates and compile times are toolchain-dependent and warn-only.
+    cur_rm = current.get("receiver_memory")
+    base_rm = baseline.get("receiver_memory")
+    if isinstance(cur_rm, dict) and isinstance(base_rm, dict):
+        for key in ("n", "capacity", "k", "member_state_bytes"):
+            if cur_rm.get(key) != base_rm.get(key):
+                errors.append(
+                    f"payload.receiver_memory.{key}: config mismatch "
+                    f"(current {cur_rm.get(key)!r} vs baseline "
+                    f"{base_rm.get(key)!r}) — regenerate with "
+                    f"--update-baseline")
+        base_fleets = {f.get("fleet_size"): f
+                       for f in base_rm.get("fleets", [])}
+        for fl in cur_rm.get("fleets", []):
+            fsz = fl.get("fleet_size")
+            where = f"payload.receiver_memory.fleets[F={fsz}]"
+            base_f = base_fleets.get(fsz)
+            if base_f is None:
+                warnings.append(f"{where}: no baseline fleet at this size "
+                                f"(baseline sizes {sorted(base_fleets)})")
+                continue
+            for key in ("argument_bytes", "output_bytes"):
+                if fl.get(key) != base_f.get(key):
+                    errors.append(f"{where}.{key}: {fl.get(key)!r} != "
+                                  f"baseline {base_f.get(key)!r}")
+            for key in ("temp_bytes", "peak_bytes"):
+                cur_v, base_v = fl.get(key), base_f.get(key)
+                if isinstance(cur_v, int) and isinstance(base_v, int) and \
+                        base_v > 0 and \
+                        cur_v > base_v * (1.0 + wall_tolerance):
+                    up = 100.0 * (cur_v / base_v - 1.0)
+                    warnings.append(
+                        f"{where}.{key}: {cur_v} is {up:.0f}% above "
+                        f"baseline {base_v} (tolerance "
+                        f"{wall_tolerance * 100:.0f}%)")
     return errors, warnings
 
 

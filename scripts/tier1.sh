@@ -87,6 +87,33 @@ if [ "$rc" -eq 0 ]; then
     fi
 fi
 
+# Partition-exact smoke: an N=64 campaign must dispatch its link-fault
+# members through the per-receiver engine (device-exact protocol state
+# per slot) and the partition spot-check must replay through
+# run_receiver_differential — the payload has to show a passed spot
+# member in per_receiver mode, not the shared-state referee.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 6 --fleet-size 6 --n 64 --ticks 160 \
+            --spot-checks 1 --out /tmp/_t1_rx.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_rx.json \
+        && python -c '
+import json, sys
+camp = json.load(open("/tmp/_t1_rx.json"))["campaign"]
+pr = camp["per_receiver"]
+spot = camp["spot_checks"]["members"]
+ok = (pr["enabled"] and pr["members"] >= 1
+      and pr["member_state_bytes"] > 0
+      and any(m["kind"] == "partition" and m["mode"] == "per_receiver"
+              and m["passed"] for m in spot))
+sys.exit(0 if ok else 1)'; then
+        echo PARTITION_EXACT_SMOKE=ok
+    else
+        echo PARTITION_EXACT_SMOKE=failed
+        rc=1
+    fi
+fi
+
 # Kernel-profile smoke: the per-kernel cost observatory must lower every
 # sub-kernel and emit a schema-valid dominance report (small N, few
 # repeats — the full 1k/10k/100k sweep is run manually; see

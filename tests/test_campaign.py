@@ -1,4 +1,4 @@
-"""Campaign driver: determinism, schema v3 payloads, and fleet folds.
+"""Campaign driver: determinism, schema v4 payloads, and fleet folds.
 
 The campaign block of a bench payload is exact-compared by
 ``scripts/bench_compare.py``, so everything derived from the campaign
@@ -47,8 +47,8 @@ def test_campaign_is_deterministic_across_dispatches(tiny_payload):
         json.dumps(_strip_wall(again), sort_keys=True)
 
 
-def test_campaign_payload_passes_schema_v3(tiny_payload):
-    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 3
+def test_campaign_payload_passes_schema_v4(tiny_payload):
+    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 4
     assert tschema.validate_bench_payload(tiny_payload) == []
     camp = tiny_payload["campaign"]
     assert camp["clusters"] == TINY.clusters
@@ -57,6 +57,54 @@ def test_campaign_payload_passes_schema_v3(tiny_payload):
     assert dists["clusters"] == TINY.clusters
     for key in tschema.CAMPAIGN_DISTRIBUTIONS:
         assert set(dists[key]) == {"count", "p50", "p90", "p99", "max"}
+    # v4: the per-receiver accounting block must reconcile with the
+    # scenario-kind split and carry a real memory figure
+    pr = camp["per_receiver"]
+    assert pr["enabled"] is True
+    assert 0 <= pr["members"] <= TINY.clusters
+    assert sum(pr["kinds"].values()) == pr["members"]
+    assert pr["member_state_bytes"] > 0
+    assert pr["capacity"] >= TINY.n
+
+
+def test_spot_check_graceful_degradation(monkeypatch, tmp_path):
+    """A spot-check divergence must not kill the campaign outright: with
+    ``max_spot_failures`` headroom the payload records structured failure
+    members (error line + forensics artifact path) and still validates;
+    with the default of 0 the campaign aborts, naming the members."""
+    from types import SimpleNamespace
+
+    from rapid_tpu.engine import diff as diff_mod
+    from rapid_tpu.telemetry.forensics import DivergenceError
+
+    class _DivergingResult:
+        def assert_identical(self, artifact=None):
+            if artifact:
+                with open(artifact, "w") as fh:
+                    fh.write('{"synthetic": true}\n')
+            report = SimpleNamespace(render=lambda: "synthetic divergence")
+            raise DivergenceError(report, artifact)
+
+    def _diverge(schedule, n_ticks, settings=None):
+        return _DivergingResult()
+
+    monkeypatch.setattr(diff_mod, "run_receiver_differential", _diverge)
+    monkeypatch.setattr(diff_mod, "run_adversarial_differential", _diverge)
+
+    kw = dict(clusters=2, n=16, ticks=60, seed=11, fleet_size=2,
+              headroom=8, spot_checks=2, artifact_dir=str(tmp_path))
+    payload = run_campaign(CampaignConfig(max_spot_failures=2, **kw))
+    spot = payload["campaign"]["spot_checks"]
+    assert spot["run"] == 2 and spot["failed"] == 2 and spot["passed"] == 0
+    assert spot["max_failures"] == 2
+    for rec in spot["members"]:
+        assert rec["passed"] is False
+        assert rec["error"] == "synthetic divergence"
+        assert rec["artifact"] and rec["artifact"].startswith(str(tmp_path))
+    assert tschema.validate_bench_payload(payload) == []
+
+    with pytest.raises(RuntimeError, match="spot-check divergence"):
+        run_campaign(CampaignConfig(**kw))
 
 
 def _summary(**kw):

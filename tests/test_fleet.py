@@ -27,8 +27,9 @@ from rapid_tpu.engine.step import simulate
 # rapid_tpu.engine re-exports the `step` *function*, which shadows the
 # module under `from rapid_tpu.engine import step`.
 step_mod = importlib.import_module("rapid_tpu.engine.step")
-from rapid_tpu.faults import (AdversarySchedule, ScenarioWeights,
-                              ScriptedPropose, random_adversary_schedule,
+from rapid_tpu.faults import (AdversarySchedule, LinkWindow,
+                              ScenarioWeights, ScriptedPropose,
+                              random_adversary_schedule,
                               sample_adversary_schedule, validate_schedule)
 from rapid_tpu.settings import Settings
 
@@ -184,3 +185,62 @@ def test_churn_kind_flags_wants_churn():
     sc = sample_adversary_schedule(N, 0, 200, only_churn)
     assert sc.kind == "churn" and sc.wants_churn
     assert not sc.schedule.windows and not sc.schedule.proposes
+
+
+def test_validate_schedule_rejects_malformed_windows():
+    """Zero-length and empty-endpoint windows are silent no-ops in the
+    engine (they never match a delivery), so the validator refuses them
+    up front rather than letting a campaign run a fault that never
+    fired."""
+    iso = frozenset(range(4))
+    rest = frozenset(range(N)) - iso
+
+    def _sched(win):
+        return AdversarySchedule(n=N, windows=(win,), seed=0)
+
+    with pytest.raises(ValueError, match="zero-length window"):
+        validate_schedule(_sched(LinkWindow(src_slots=rest, dst_slots=iso,
+                                            start_tick=10, end_tick=10)))
+    with pytest.raises(ValueError, match="zero-length window"):
+        validate_schedule(_sched(LinkWindow(src_slots=rest, dst_slots=iso,
+                                            start_tick=12, end_tick=10)))
+    with pytest.raises(ValueError, match="non-empty"):
+        validate_schedule(_sched(LinkWindow(src_slots=frozenset(),
+                                            dst_slots=iso,
+                                            start_tick=0, end_tick=5)))
+    with pytest.raises(ValueError, match="non-empty"):
+        validate_schedule(_sched(LinkWindow(src_slots=iso,
+                                            dst_slots=frozenset(),
+                                            start_tick=0, end_tick=5)))
+
+
+def test_validate_schedule_rejects_overlapping_static_windows():
+    """Two static windows covering the same directed edge at the same
+    tick are ambiguous authorship of one drop; the validator rejects
+    the pair, including through ``two_way`` expansion. Flip-flop
+    (periodic) windows are exempt — their phases interleave by design."""
+    a = LinkWindow(src_slots=frozenset({4, 5}), dst_slots=frozenset({0, 1}),
+                   start_tick=5, end_tick=20)
+    b = LinkWindow(src_slots=frozenset({5, 6}), dst_slots=frozenset({1, 2}),
+                   start_tick=15, end_tick=30)
+    with pytest.raises(ValueError, match="overlapping static windows"):
+        validate_schedule(AdversarySchedule(n=N, windows=(a, b), seed=0))
+
+    # identical edges but disjoint tick ranges: fine
+    c = LinkWindow(src_slots=frozenset({5, 6}), dst_slots=frozenset({1, 2}),
+                   start_tick=20, end_tick=30)
+    validate_schedule(AdversarySchedule(n=N, windows=(a, c), seed=0))
+
+    # the reverse direction added by two_way collides with a forward one
+    fwd = LinkWindow(src_slots=frozenset({0}), dst_slots=frozenset({1}),
+                     start_tick=0, end_tick=50)
+    rev = LinkWindow(src_slots=frozenset({1}), dst_slots=frozenset({0}),
+                     start_tick=10, end_tick=20, two_way=True)
+    with pytest.raises(ValueError, match="overlapping static windows"):
+        validate_schedule(AdversarySchedule(n=N, windows=(fwd, rev), seed=0))
+
+    # flip-flop windows may share edges with a static window
+    flip = LinkWindow(src_slots=frozenset({4, 5}),
+                      dst_slots=frozenset({0, 1}),
+                      start_tick=5, end_tick=40, period_ticks=5)
+    validate_schedule(AdversarySchedule(n=N, windows=(a, flip), seed=0))

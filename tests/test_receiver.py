@@ -1,0 +1,324 @@
+"""Per-receiver engine: device-exact link faults.
+
+The claims pinned here close the fleet fidelity envelope:
+
+- ``run_receiver_differential`` is bit-identical to the host per-slot
+  adversary referee — per-slot event streams, per-tick counters,
+  per-phase consensus traffic and per-slot final config ids — for crash
+  bursts, one-way partitions, classic-fallback chains and sampled
+  partition/flip-flop scenarios;
+- LinkWindow *boundary* semantics are exact: a one-tick window, a
+  delivery exactly at window close, and a flip-flop phase edge all
+  reproduce at N=64 through both referee layers (oracle vs host engine,
+  host engine vs device kernel);
+- a stacked per-receiver fleet member is bit-identical to the same
+  scenario run unbatched (vmap never changes the protocol);
+- the memory table ``receiver_field_shapes`` pins the real state
+  (shapes and itemsizes), the budget gate raises the structured
+  ``ReceiverBudgetError``, and envelope flags decode to named reasons;
+- the shared-state step's jaxpr is untouched by per-receiver mode —
+  the fast path retraces nothing when the new engine is off.
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+
+from rapid_tpu.engine import fleet as fleet_mod
+from rapid_tpu.engine import receiver as rx_mod
+from rapid_tpu.engine.diff import (run_adversarial_differential,
+                                   run_receiver_differential)
+from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+from rapid_tpu.faults import (AdversarySchedule, LinkWindow,
+                              ScenarioWeights, ScriptedPropose,
+                              sample_adversary_schedule)
+from rapid_tpu.settings import Settings
+
+step_mod = importlib.import_module("rapid_tpu.engine.step")
+
+SETTINGS = Settings()
+TICKS = 120
+
+
+def _assert_tree_equal(a, b, what):
+    for field, x, y in zip(type(a)._fields, a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{what}: field {field} diverged"
+
+
+def _assert_exact(result):
+    result.assert_identical()
+    assert result.engine_phase_counters == result.oracle_phase_counters
+    assert result.engine_config_ids == result.oracle_config_ids
+
+
+# ---------------------------------------------------------------------------
+# differential exactness
+# ---------------------------------------------------------------------------
+
+
+def test_crash_burst_differential():
+    sched = AdversarySchedule(n=8, crashes=((1, 4), (5, 4), (6, 12)),
+                              seed=3)
+    _assert_exact(run_receiver_differential(sched, 80, SETTINGS))
+
+
+def test_one_way_partition_differential():
+    iso = frozenset(range(4))
+    rest = frozenset(range(4, 16))
+    sched = AdversarySchedule(
+        n=16, crashes=((9, 30),),
+        windows=(LinkWindow(src_slots=rest, dst_slots=iso, start_tick=6),),
+        seed=7)
+    result = run_receiver_differential(sched, 160, SETTINGS)
+    _assert_exact(result)
+    # the isolated side must actually diverge from the rest: different
+    # slots end on different configs, or the check is vacuous
+    assert len(set(result.engine_config_ids)) > 1
+
+
+def test_classic_chain_partition_exercises_all_phases():
+    """An isolated majority-breaking group forces the classic fallback;
+    every Paxos phase must carry traffic and still match per-slot."""
+    iso = frozenset(range(5))
+    rest = frozenset(range(5, 16))
+    sched = AdversarySchedule(
+        n=16,
+        windows=(LinkWindow(src_slots=rest, dst_slots=iso, start_tick=6,
+                            two_way=True),),
+        seed=13)
+    result = run_receiver_differential(sched, 160, SETTINGS)
+    _assert_exact(result)
+    totals = {k: sum(row[k] for row in result.engine_phase_counters)
+              for k in result.engine_phase_counters[0]}
+    for phase in ("phase1a_sent", "phase1b_sent", "phase2a_sent",
+                  "phase2b_sent"):
+        assert totals[phase] > 0, f"{phase} never fired"
+
+
+@pytest.mark.parametrize("kind", ["partition", "flip_flop"])
+def test_sampled_link_fault_schedules_are_device_exact(kind):
+    weights = ScenarioWeights(
+        **{k: (1.0 if k == kind else 0.0)
+           for k in ("crash", "partition", "flip_flop", "contested",
+                     "churn")})
+    for seed in range(6):
+        sc = sample_adversary_schedule(16, seed, TICKS, weights)
+        assert sc.kind == kind
+        _assert_exact(run_receiver_differential(sc.schedule, TICKS,
+                                                SETTINGS))
+
+
+def test_link_window_boundary_semantics_n64():
+    """Satellite: deliveries exactly at a window's open/close tick, a
+    one-tick window, and a flip-flop phase edge — exact at N=64 through
+    both referee layers (oracle vs host engine, host engine vs device).
+
+    FD probes are the traffic probe: they evaluate link reachability at
+    ticks ≡ 0 (mod ``fd_interval_ticks``), so the windows are pinned to
+    those delivery ticks. ``w_one`` blacks out exactly one probe tick;
+    ``w_edge`` *opens* exactly on a probe tick and its half-open
+    ``end_tick`` lands exactly on the next-but-one, which must get
+    through; ``w_flip`` flips phase exactly at every probe tick."""
+    n = 64
+    iso_a = frozenset(range(8))            # one-tick blackout at t=30
+    iso_b = frozenset(range(8, 20))        # opens at 30, ends AT 50
+    iso_c = frozenset(range(20, 28))       # flip-flop, period = interval
+    rest = frozenset(range(n))
+    sched = AdversarySchedule(
+        n=n,
+        windows=(
+            # src excludes iso_b so the one-tick window shares no directed
+            # edge with w_edge's two-way reverse (the validator rejects
+            # overlapping static windows on the same edge)
+            LinkWindow(src_slots=rest - iso_a - iso_b, dst_slots=iso_a,
+                       start_tick=30, end_tick=31),
+            LinkWindow(src_slots=rest - iso_b, dst_slots=iso_b,
+                       start_tick=30, end_tick=50, two_way=True),
+            LinkWindow(src_slots=rest - iso_c, dst_slots=iso_c,
+                       start_tick=30, period_ticks=10),
+        ),
+        seed=21)
+    dev = run_receiver_differential(sched, TICKS, SETTINGS)
+    _assert_exact(dev)
+    host = run_adversarial_differential(sched, TICKS, SETTINGS)
+    _assert_exact(host)
+    pf = {m.tick: m.probes_failed for m in dev.engine_metrics}
+    # t=30: all three windows bite (w_one's single tick is exactly here)
+    # t=40: w_edge still active, w_flip in its open phase, w_one gone
+    # t=50: w_edge's end_tick — the probe must pass; w_flip blocks again
+    # t=60: only w_flip, open phase -> clean tick
+    # t=70: w_flip blocked phase again, same edges as t=50
+    assert pf[30] > pf[40] + pf[50] > 0
+    assert pf[40] > 0 and pf[50] > 0
+    assert pf[60] == 0
+    assert pf[70] == pf[50]
+
+
+# ---------------------------------------------------------------------------
+# fleet batching
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_slice_matches_unbatched_receiver_run():
+    """Member i of a stacked per-receiver fleet == the same scenario
+    run through ``receiver_simulate`` alone, bit for bit."""
+    weights = ScenarioWeights(crash=0, partition=1, flip_flop=1,
+                              contested=0, churn=0)
+    schedules = [sample_adversary_schedule(16, s, 80, weights).schedule
+                 for s in (2, 5, 9)]
+    members = [fleet_mod.lower_receiver_schedule(s, SETTINGS)
+               for s in schedules]
+    fleet = fleet_mod.stack_receiver_members(members)
+    f_finals, f_logs = fleet_mod.receiver_fleet_simulate(fleet, 80,
+                                                         SETTINGS)
+    for i, m in enumerate(members):
+        s_final, s_logs = rx_mod.receiver_simulate(
+            m.state, fleet_mod.pad_link_windows(
+                m.faults, int(fleet.faults.link_src.shape[1])),
+            80, SETTINGS)
+        sl_final = jax.tree_util.tree_map(lambda x, i=i: x[i], f_finals)
+        sl_logs = jax.tree_util.tree_map(lambda x, i=i: x[i], f_logs)
+        _assert_tree_equal(sl_final, s_final, f"member {i} final")
+        _assert_tree_equal(sl_logs, s_logs, f"member {i} logs")
+        rx_mod.check_flags(sl_final.flags)
+
+
+def test_lower_receiver_schedule_rejects_proposes():
+    sched = AdversarySchedule(n=8, proposes=(
+        ScriptedPropose(slot=0, tick=5, proposal=(1,), delay_ticks=3),),
+        seed=0)
+    with pytest.raises(ValueError, match="propose"):
+        fleet_mod.lower_receiver_schedule(sched, SETTINGS)
+    with pytest.raises(ValueError, match="propose"):
+        run_receiver_differential(sched, 40, SETTINGS)
+
+
+# ---------------------------------------------------------------------------
+# memory table, budget gate, envelope flags
+# ---------------------------------------------------------------------------
+
+
+def test_field_shapes_pin_real_state():
+    """Every entry of the sizing table matches a real instantiation —
+    shape and itemsize — so ``receiver_state_bytes`` cannot drift."""
+    from rapid_tpu.oracle.membership_view import id_fingerprint, uid_of
+    from rapid_tpu.engine.diff import default_endpoints, default_node_ids
+
+    n = 12
+    uids = [uid_of(e) for e in default_endpoints(n)]
+    fp = sum(id_fingerprint(i) for i in default_node_ids(n)) \
+        & ((1 << 64) - 1)
+    rs = rx_mod.init_receiver_state(uids, fp, SETTINGS.with_(capacity=n),
+                                    seed=0)
+    table = rx_mod.receiver_field_shapes(n, SETTINGS.K)
+    total = 0
+    for field, leaf in zip(type(rs)._fields, rs):
+        shape, itemsize = table[field]
+        arr = np.asarray(leaf)
+        assert arr.shape == shape, f"{field}: {arr.shape} != {shape}"
+        assert arr.dtype.itemsize == itemsize, \
+            f"{field}: itemsize {arr.dtype.itemsize} != {itemsize}"
+        total += arr.nbytes
+    assert total == rx_mod.receiver_state_bytes(n, SETTINGS.K)
+
+
+def test_budget_gate_raises_structured_error():
+    tight = SETTINGS.with_(receiver_capacity_cap=8)
+    with pytest.raises(fleet_mod.ReceiverBudgetError) as exc:
+        fleet_mod.check_receiver_budget(16, 4, tight)
+    err = exc.value
+    assert err.capacity == 16 and err.fleet_size == 4 and err.cap == 8
+    assert err.member_bytes == rx_mod.receiver_state_bytes(16, tight.K)
+    assert err.total_bytes == 4 * err.member_bytes
+    assert "receiver_capacity_cap" in str(err)
+    # under the cap: returns the per-member bytes, raises nothing
+    assert fleet_mod.check_receiver_budget(8, 4, tight) == \
+        rx_mod.receiver_state_bytes(8, tight.K)
+
+
+def test_campaign_refuses_oversized_per_receiver_fleet():
+    """The campaign surfaces the budget refusal before any device work
+    (acceptance: structured error naming the measured budget, not OOM)."""
+    from rapid_tpu.campaign import CampaignConfig, run_campaign
+
+    cfg = CampaignConfig(
+        clusters=2, n=16, ticks=40, fleet_size=2, seed=1,
+        weights=ScenarioWeights(crash=0, partition=1, flip_flop=0,
+                                contested=0, churn=0),
+        settings=Settings(receiver_capacity_cap=8))
+    with pytest.raises(fleet_mod.ReceiverBudgetError, match="over budget"):
+        run_campaign(cfg)
+
+
+def test_envelope_flags_decode_and_raise():
+    assert rx_mod.decode_flags(0) == []
+    names = rx_mod.decode_flags(rx_mod.FLAG_DECIDE_NOT_IN_VIEW
+                                | rx_mod.FLAG_DRAWS_EXHAUSTED)
+    assert "decide-host-not-in-view" in names
+    assert "fallback-delay-draws-exhausted" in names
+    rx_mod.check_flags(0)  # clean: no raise
+    with pytest.raises(rx_mod.ReceiverEnvelopeError,
+                       match="draws-exhausted"):
+        rx_mod.check_flags(rx_mod.FLAG_DRAWS_EXHAUSTED)
+
+
+def test_init_rejects_batched_windows():
+    with pytest.raises(ValueError, match="batching"):
+        rx_mod.init_receiver_state(
+            [1, 2, 3, 4], 0,
+            SETTINGS.with_(capacity=4, batching_window_ticks=2), seed=0)
+
+
+# ---------------------------------------------------------------------------
+# fleet sharding specs
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_spec_for_skips_fleet_axis():
+    from jax.sharding import PartitionSpec as P
+
+    from rapid_tpu.engine import sharding
+
+    mesh = sharding.slot_mesh(8)
+    c = 16
+    assert sharding.fleet_spec_for((4, c, c), c, mesh) == \
+        P(None, sharding.AXIS)
+    assert sharding.fleet_spec_for((4, c, c, 10), c, mesh) == \
+        P(None, sharding.AXIS)
+    assert sharding.fleet_spec_for((4,), c, mesh) == P()
+    # F == C must never shard the fleet axis itself
+    assert sharding.fleet_spec_for((c, c), c, mesh) == \
+        P(None, sharding.AXIS)
+    # capacity not dividing the mesh replicates (divisibility guard)
+    assert sharding.fleet_spec_for((4, 12, 12), 12, mesh) == P()
+
+
+# ---------------------------------------------------------------------------
+# shared-state fast path is untouched
+# ---------------------------------------------------------------------------
+
+
+def _shared_step_jaxpr(settings):
+    n = 16
+    from rapid_tpu import hashing
+
+    hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF)
+    uids = hashing.np_from_limbs(hi, lo)
+    state = init_state(uids, id_fp_sum=0, settings=settings)
+    faults = crash_faults([I32_MAX] * n)
+    return str(jax.make_jaxpr(
+        lambda st, fa: step_mod.step(st, fa, settings))(state, faults))
+
+
+def test_shared_step_jaxpr_unchanged_by_receiver_mode():
+    """The per-receiver engine is a separate kernel: flipping its only
+    Settings knob — and having imported the module at all — must leave
+    the shared-state step's traced program byte-identical."""
+    base = _shared_step_jaxpr(SETTINGS)
+    assert base == _shared_step_jaxpr(
+        SETTINGS.with_(receiver_capacity_cap=64))
+    assert "receiver" not in base

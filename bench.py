@@ -9,8 +9,9 @@ classic-Paxos fallback kernel, a small one-way-partition run
 through the fault adversary (a host-side oracle differential, so it
 uses its own ``--partition-n`` size), and a deterministic Monte-Carlo
 ``fleet`` campaign (``--fleet-clusters`` N=``--fleet-n`` clusters with
-a mixed fault/churn sample, vmapped into one dispatch; see
-``rapid_tpu/campaign.py``) — with defaults small enough to finish
+a mixed fault/churn sample, vmapped ``--fleet-size`` clusters per
+dispatch so the committed payload carries a multi-dispatch timeline;
+see ``rapid_tpu/campaign.py``) — with defaults small enough to finish
 quickly on CPU, and emits a single ``engine_tick_suite`` JSON payload.
 
 The stdout payload is always one compact *summary-only* line (the last
@@ -88,15 +89,20 @@ def main(argv=None) -> int:
                         help="ticks for the partition run (needs to "
                              "cover FD saturation plus the classic "
                              "fallback round; default 300)")
-    parser.add_argument("--fleet-clusters", type=int, default=64,
+    parser.add_argument("--fleet-clusters", type=int, default=128,
                         help="clusters in the fleet campaign entry "
-                             "(one vmapped dispatch; default 64)")
+                             "(default 128: two shared dispatches of "
+                             "--fleet-size, so the dispatch timeline "
+                             "shows the compile-vs-cache-hit split)")
+    parser.add_argument("--fleet-size", type=int, default=64,
+                        help="clusters per jitted fleet dispatch "
+                             "(default 64)")
     parser.add_argument("--fleet-n", type=int, default=64,
                         help="members per fleet cluster (default 64)")
-    parser.add_argument("--fleet-ticks", type=int, default=240,
+    parser.add_argument("--fleet-ticks", type=int, default=120,
                         help="ticks per fleet cluster (covers FD "
-                             "saturation, partitions healing at half "
-                             "run, and churn cycles; default 240)")
+                             "saturation and partitions healing at "
+                             "half run; default 120)")
     parser.add_argument("--out", type=str, default=None,
                         help="write the JSON artifact to FILE "
                              "(default: stdout)")
@@ -119,11 +125,13 @@ def main(argv=None) -> int:
         "partition": run_partition(args.partition_n, args.partition_ticks,
                                    settings, args.seed),
         "fleet": run_fleet(args.fleet_clusters, args.fleet_n,
-                           args.fleet_ticks, settings, args.seed),
+                           args.fleet_ticks, settings, args.seed,
+                           fleet_size=args.fleet_size),
     }
     if args.out:
-        with open(args.out, "w") as fh:
-            fh.write(json.dumps(payload, indent=2) + "\n")
+        from rapid_tpu.telemetry import write_json_artifact
+
+        write_json_artifact(args.out, payload, indent=2)
     # The compact summary line always goes to stdout (flushed) so the
     # harness's tail-capture works whether or not --out was given.
     sys.stdout.write(

@@ -426,8 +426,9 @@ def main(argv=None) -> int:
                                   repeats=args.profile_repeats,
                                   seed=args.seed)
         if args.out:
-            with open(args.out, "w") as fh:
-                fh.write(json.dumps(report, indent=2) + "\n")
+            from rapid_tpu.telemetry import write_json_artifact
+
+            write_json_artifact(args.out, report, indent=2)
         else:
             sys.stdout.write(json.dumps(report) + "\n")
             sys.stdout.flush()
@@ -468,12 +469,14 @@ def main(argv=None) -> int:
     if writer is not None:
         writer.write(args.trace)
         payload["trace"] = args.trace
-    # BENCH artifacts end with a newline (ADVICE.md round-5 nit). On
-    # stdout the payload is one compact line, so harnesses that parse the
-    # last stdout line always get the whole JSON object.
+    # BENCH artifacts end with a newline (telemetry.write_json_artifact
+    # is the chokepoint). On stdout the payload is one compact line, so
+    # harnesses that parse the last stdout line always get the whole
+    # JSON object.
     if args.out:
-        with open(args.out, "w") as fh:
-            fh.write(json.dumps(payload, indent=2) + "\n")
+        from rapid_tpu.telemetry import write_json_artifact
+
+        write_json_artifact(args.out, payload, indent=2)
     else:
         sys.stdout.write(json.dumps(payload) + "\n")
     return 0

@@ -41,10 +41,26 @@ only when failures exceed ``--max-spot-failures`` (default 0 keeps the
 old strictness). This referee loop is the only host-side part of a
 campaign.
 
+Dispatch observatory (schema v5): every stage of every dispatch —
+schedule sampling, member lowering, ``stack_members`` padding, the
+one-time AOT XLA compile (``fleet.fleet_aot_compile``; later dispatches
+of the same mode reuse the executable with zero compile wall), the
+fenced device execute, and the summary fold — is timed into one
+``dispatch_timeline`` record per dispatch, with member-kind mix,
+padding waste against the campaign-global stacking maxima, host-blocked
+fraction, and a device-memory watermark. The top-level ``observatory``
+block folds those into host-blocked vs device-busy wall accounting
+(the double-buffering headroom figure), and ``clusters_per_sec`` is the
+campaign throughput row ``scripts/bench_compare.py`` gates. ``--trace``
+exports the same stages as Perfetto wall-clock spans
+(``telemetry.trace``); ``--progress`` emits one JSONL heartbeat line
+per completed dispatch so long campaigns are monitorable.
+
 CLI::
 
     python -m rapid_tpu.campaign --clusters 1024 --n 64 --ticks 240 \
-        --seed 0 --fleet-size 64 --spot-checks 8 --out campaign.json
+        --seed 0 --fleet-size 64 --spot-checks 8 --out campaign.json \
+        --trace campaign_trace.json --progress -
 """
 from __future__ import annotations
 
@@ -69,6 +85,47 @@ __all__ = ["CampaignConfig", "run_campaign", "main"]
 #: a partition (link-masked FD path) and a contested split (classic-Paxos
 #: fallback on both sides of the differential).
 REQUIRED_SPOT_KINDS = ("partition", "contested")
+
+#: Walls below this are timer noise on every supported platform; rates
+#: derived from them (``ticks_per_sec``, ``clusters_per_sec``) are
+#: reported as ``null`` instead of a garbage division.
+MIN_MEASURABLE_WALL_S = 1e-3
+
+
+def _rate(numerator: float, wall_s: float) -> Optional[float]:
+    """``numerator / wall_s``, or None when the wall is unmeasurable."""
+    if wall_s < MIN_MEASURABLE_WALL_S:
+        return None
+    return numerator / wall_s
+
+
+class _ProgressWriter:
+    """``--progress`` JSONL heartbeat: one flushed, newline-terminated
+    line per completed dispatch (and per spot check), so a ≥100k-cluster
+    campaign is monitorable instead of silent for minutes. ``-`` streams
+    to stderr; None disables at zero cost."""
+
+    def __init__(self, path: Optional[str]) -> None:
+        self._fh = None
+        self._own = False
+        if path == "-":
+            self._fh = sys.stderr
+        elif path:
+            self._fh = open(path, "w")
+            self._own = True
+
+    def emit(self, record: Dict[str, object]) -> None:
+        if self._fh is None:
+            return
+        from rapid_tpu.telemetry import json_artifact_line
+
+        self._fh.write(json_artifact_line(record, sort_keys=True))
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._own and self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,7 +201,9 @@ def _chunks(seq: List[int], size: int) -> List[List[int]]:
 
 
 def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
-                referee_settings: Settings) -> Dict[str, object]:
+                referee_settings: Settings, writer=None,
+                progress: Optional[_ProgressWriter] = None
+                ) -> Dict[str, object]:
     """Replay a seeded member subset through the host oracle referee.
 
     Per-receiver-eligible kinds replay through
@@ -166,6 +225,7 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
                                        run_receiver_differential)
     from rapid_tpu.engine.receiver import ReceiverEnvelopeError
     from rapid_tpu.telemetry.forensics import DivergenceError
+    from rapid_tpu.telemetry.trace import wall_span
 
     requested = cfg.spot_checks
     block: Dict[str, object] = {"requested": requested, "run": 0,
@@ -215,8 +275,11 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
             "passed": True, "artifact": None, "error": None}
         block["run"] += 1
         try:
-            result = runner(sc.schedule, cfg.ticks, referee_settings)
-            result.assert_identical(artifact=artifact)
+            with wall_span(writer, "spot_check",
+                           {"member": idx, "kind": sc.kind,
+                            "mode": record["mode"]}):
+                result = runner(sc.schedule, cfg.ticks, referee_settings)
+                result.assert_identical(artifact=artifact)
             block["passed"] += 1
         except (DivergenceError, ReceiverEnvelopeError) as err:
             record["passed"] = False
@@ -225,6 +288,12 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
             record["error"] = str(err).splitlines()[0]
             block["failed"] += 1
         block["members"].append(record)
+        if progress is not None:
+            progress.emit({"record": "spot_check", "member": idx,
+                           "kind": sc.kind, "passed": record["passed"],
+                           "run": block["run"],
+                           "requested": block["requested"],
+                           "spot_failures": block["failed"]})
     if block["failed"] > cfg.max_spot_failures:
         bad = [m for m in block["members"] if not m["passed"]]
         raise RuntimeError(
@@ -238,23 +307,56 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
     return block
 
 
-def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
-    """Run one campaign; returns a schema-v4 bench run payload.
+def _live_buffer_bytes(jax) -> int:
+    """Process-wide live device-buffer watermark (bytes)."""
+    try:
+        return int(sum(getattr(a, "nbytes", 0) or 0
+                       for a in jax.live_arrays()))
+    except Exception:
+        return 0
+
+
+def _device_peak_bytes(jax) -> Optional[int]:
+    """Allocator peak from ``device.memory_stats()``; None on backends
+    that expose no stats (CPU)."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None
+
+
+def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
+                 progress_path: Optional[str] = None) -> Dict[str, object]:
+    """Run one campaign; returns a schema-v5 bench run payload.
 
     The payload validates as an ``engine_tick`` run (``telemetry`` is the
     fleet-merged ``RunSummary``) and additionally carries the
-    ``campaign`` block: scenario-kind counts, spot-check results, and
-    nearest-rank distributions over per-member summaries.
-    ``ticks_per_sec`` is aggregate cluster-ticks per second across all
-    dispatches (compile included — campaigns are one-shot programs).
+    ``campaign`` block (scenario-kind counts, spot-check results,
+    nearest-rank distributions) plus the dispatch observatory:
+    ``dispatch_timeline`` (one per-stage wall record per dispatch),
+    ``observatory`` (host-blocked vs device-busy vs compile wall
+    accounting), and ``clusters_per_sec``. ``wall_s`` is the end-to-end
+    campaign wall — sampling, lowering, stacking, the one-time AOT
+    compiles, execution, and folds; the per-dispatch stage walls sum to
+    it within ``schema.STAGE_SUM_TOLERANCE``. Oracle spot-check replay
+    is outside ``wall_s`` (``spot_check_s``; ``total_s`` is the sum).
+
+    ``trace_path`` exports the stages as Perfetto wall-clock spans;
+    ``progress_path`` streams a JSONL heartbeat (``-`` for stderr).
+    Both are I/O knobs, not campaign identity — everything derived from
+    ``cfg`` stays bit-identical with or without them.
     """
     import jax
 
     from rapid_tpu.engine import receiver as receiver_mod
     from rapid_tpu.engine.fleet import (check_receiver_budget,
-                                        fleet_simulate,
+                                        fleet_aot_compile,
                                         lower_receiver_schedule,
-                                        receiver_fleet_simulate,
+                                        receiver_fleet_aot_compile,
                                         stack_members,
                                         stack_receiver_members)
     from rapid_tpu.telemetry.metrics import (fleet_summaries,
@@ -262,6 +364,7 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
                                              summarize,
                                              summary_distributions)
     from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+    from rapid_tpu.telemetry.trace import TraceWriter, wall_span
 
     base = cfg.settings or Settings()
     c = cfg.n + cfg.headroom
@@ -275,8 +378,20 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
     dispatches = -(-cfg.clusters // f)
     total = dispatches * f
 
-    t0 = time.perf_counter()
-    scenarios = [_sample_scenario(cfg, i) for i in range(total)]
+    writer = TraceWriter() if trace_path else None
+    progress = _ProgressWriter(progress_path)
+    t_begin = time.perf_counter()
+
+    # Stage walls are measured per member here and attributed to each
+    # member's dispatch below, so the timeline shows what every dispatch
+    # *cost*, while the trace shows when the work actually ran.
+    sample_s: Dict[int, float] = {}
+    scenarios: List[SampledScenario] = []
+    with wall_span(writer, "sample", {"clusters": total}):
+        for i in range(total):
+            t0 = time.perf_counter()
+            scenarios.append(_sample_scenario(cfg, i))
+            sample_s[i] = time.perf_counter() - t0
     rx_idx = [i for i, sc in enumerate(scenarios)
               if cfg.per_receiver and _receiver_eligible(sc)]
     sh_idx = [i for i in range(total) if i not in set(rx_idx)]
@@ -286,51 +401,187 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
     if rx_idx:
         check_receiver_budget(max(rx_settings.capacity, cfg.n), fr,
                               rx_settings)
-    sh_members = {i: _lower_shared(cfg, settings, i, scenarios[i])
-                  for i in sh_idx}
-    rx_members = {i: lower_receiver_schedule(scenarios[i].schedule,
-                                             rx_settings, fleet_size=fr)
-                  for i in rx_idx}
-    boot_s = time.perf_counter() - t0
+    lower_s: Dict[int, float] = {}
+    sh_members = {}
+    rx_members = {}
+    with wall_span(writer, "lower", {"members": total}):
+        for i in sh_idx:
+            t0 = time.perf_counter()
+            sh_members[i] = _lower_shared(cfg, settings, i, scenarios[i])
+            lower_s[i] = time.perf_counter() - t0
+        for i in rx_idx:
+            t0 = time.perf_counter()
+            rx_members[i] = lower_receiver_schedule(scenarios[i].schedule,
+                                                    rx_settings,
+                                                    fleet_size=fr)
+            lower_s[i] = time.perf_counter() - t0
+    boot_s = sum(sample_s.values()) + sum(lower_s.values())
 
+    # Campaign-global padding maxima: every dispatch of a mode shares
+    # one stacked shape, so the AOT executable compiles exactly once per
+    # mode and later dispatches are pure cache hits. The inert rows this
+    # buys are reported per dispatch as padding waste.
+    sh_w = max((m.faults.n_windows for m in sh_members.values()), default=0)
+    sh_inst = max((m.fallback.inst_epoch.shape[0]
+                   for m in sh_members.values()), default=0)
+    sh_pids = max((m.fallback.table_mask.shape[1]
+                   for m in sh_members.values()), default=0)
+    rx_w = max((m.faults.n_windows for m in rx_members.values()), default=0)
+
+    fs = min(f, len(sh_idx)) if sh_idx else 0
+    timeline: List[Dict[str, object]] = []
+    compile_info: Dict[str, Optional[Dict[str, object]]] = {
+        "shared": None, "per_receiver": None}
+    executables: Dict[str, object] = {}
     summaries = []
     rx_dispatches = 0
-    t0 = time.perf_counter()
-    fold_s = 0.0
-    fs = min(f, len(sh_idx)) if sh_idx else 0
+    done = 0
+
+    def record_dispatch(mode, chunk, fleet_size, stages, compiled_now,
+                        padding):
+        nonlocal done
+        done += len(chunk)
+        kinds: Dict[str, int] = {}
+        for i in chunk:
+            k = scenarios[i].kind
+            kinds[k] = kinds.get(k, 0) + 1
+        wall = sum(stages.values())
+        rec = {
+            "index": len(timeline),
+            "mode": mode,
+            "members": len(chunk),
+            "pad_members": fleet_size - len(chunk),
+            "fleet_size": fleet_size,
+            "kinds": dict(sorted(kinds.items())),
+            "compiled": compiled_now,
+            "stages": {k: round(v, 6) for k, v in stages.items()},
+            "wall_s": round(wall, 6),
+            "clusters_per_sec": _rate(len(chunk), wall),
+            "host_blocked_frac": (
+                (wall - stages["execute"]) / wall
+                if wall >= MIN_MEASURABLE_WALL_S else None),
+            "padding": padding,
+            "memory": {"live_buffer_bytes": _live_buffer_bytes(jax),
+                       "device_peak_bytes": _device_peak_bytes(jax)},
+        }
+        timeline.append(rec)
+        progress.emit({"record": "dispatch", "index": rec["index"],
+                       "mode": mode, "clusters_done": done,
+                       "clusters_total": total, "stages": rec["stages"],
+                       "spot_failures": 0})
+        return rec
+
     for chunk in _chunks(sh_idx, fs) if fs else []:
         # Pad a trailing partial chunk by cycling its own members so
         # every shared dispatch keeps one batched program shape; padded
         # summaries are dropped below.
         padded = chunk + [chunk[i % len(chunk)]
                           for i in range(fs - len(chunk))]
-        fleet = stack_members([sh_members[i] for i in padded])
-        finals, logs = fleet_simulate(fleet, cfg.ticks, settings)
-        jax.block_until_ready(finals)
-        tf = time.perf_counter()
-        summaries += fleet_summaries(logs)[:len(chunk)]
-        fold_s += time.perf_counter() - tf
+        d = len(timeline)
+        t0 = time.perf_counter()
+        with wall_span(writer, "stack", {"dispatch": d, "mode": "shared"}):
+            fleet = stack_members([sh_members[i] for i in padded],
+                                  n_windows=sh_w, n_instances=sh_inst,
+                                  n_pids=sh_pids)
+        stack_s = time.perf_counter() - t0
+        compile_s = 0.0
+        compiled_now = "shared" not in executables
+        if compiled_now:
+            t0 = time.perf_counter()
+            with wall_span(writer, "compile",
+                           {"dispatch": d, "mode": "shared"}):
+                executables["shared"], compile_info["shared"] = \
+                    fleet_aot_compile(fleet, cfg.ticks, settings)
+            compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with wall_span(writer, "execute",
+                       {"dispatch": d, "mode": "shared",
+                        "fleet_size": fs}):
+            finals, logs = executables["shared"](fleet.state, fleet.faults,
+                                                 fleet.churn, fleet.fallback)
+            jax.block_until_ready((finals, logs))
+        execute_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with wall_span(writer, "fold", {"dispatch": d, "mode": "shared"}):
+            summaries += fleet_summaries(logs)[:len(chunk)]
+        fold_stage_s = time.perf_counter() - t0
+        record_dispatch(
+            "shared", chunk, fs,
+            {"sample": sum(sample_s[i] for i in chunk),
+             "lower": sum(lower_s[i] for i in chunk),
+             "stack": stack_s, "compile": compile_s,
+             "execute": execute_s, "fold": fold_stage_s},
+            compiled_now,
+            {"window_rows": fs * sh_w - sum(
+                sh_members[i].faults.n_windows for i in padded),
+             "fallback_instances": fs * sh_inst - sum(
+                 sh_members[i].fallback.inst_epoch.shape[0]
+                 for i in padded),
+             "fallback_pids": fs * sh_pids - sum(
+                 sh_members[i].fallback.table_mask.shape[1]
+                 for i in padded)})
+
     for chunk in _chunks(rx_idx, fr) if fr else []:
         padded = chunk + [chunk[i % len(chunk)]
                           for i in range(fr - len(chunk))]
-        fleet = stack_receiver_members([rx_members[i] for i in padded])
-        finals, logs = receiver_fleet_simulate(fleet, cfg.ticks,
+        d = len(timeline)
+        t0 = time.perf_counter()
+        with wall_span(writer, "stack",
+                       {"dispatch": d, "mode": "per_receiver"}):
+            fleet = stack_receiver_members([rx_members[i] for i in padded],
+                                           n_windows=rx_w)
+        stack_s = time.perf_counter() - t0
+        compile_s = 0.0
+        compiled_now = "per_receiver" not in executables
+        if compiled_now:
+            t0 = time.perf_counter()
+            with wall_span(writer, "compile",
+                           {"dispatch": d, "mode": "per_receiver"}):
+                executables["per_receiver"], \
+                    compile_info["per_receiver"] = \
+                    receiver_fleet_aot_compile(fleet, cfg.ticks,
                                                rx_settings)
-        jax.block_until_ready(finals)
+            compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with wall_span(writer, "execute",
+                       {"dispatch": d, "mode": "per_receiver",
+                        "fleet_size": fr}):
+            finals, logs = executables["per_receiver"](fleet.state,
+                                                       fleet.faults)
+            jax.block_until_ready((finals, logs))
+        execute_s = time.perf_counter() - t0
         rx_dispatches += 1
-        tf = time.perf_counter()
-        for j in range(len(chunk)):
-            mrs = jax.tree_util.tree_map(lambda x, j=j: x[j], finals)
-            mlog = jax.tree_util.tree_map(lambda x, j=j: x[j], logs)
-            # A nonzero envelope flag would void the device-exact claim
-            # for this member; eligibility keeps schedules inside the
-            # envelope, so this raising means an engine bug.
-            receiver_mod.check_flags(mrs.flags)
-            run = receiver_mod.receiver_run_payload(mrs, mlog, cfg.n,
-                                                    cfg.ticks)
-            summaries.append(summarize(run.metrics()))
-        fold_s += time.perf_counter() - tf
-    wall_s = time.perf_counter() - t0 - fold_s
+        t0 = time.perf_counter()
+        with wall_span(writer, "fold",
+                       {"dispatch": d, "mode": "per_receiver"}):
+            for j in range(len(chunk)):
+                mrs = jax.tree_util.tree_map(lambda x, j=j: x[j], finals)
+                mlog = jax.tree_util.tree_map(lambda x, j=j: x[j], logs)
+                # A nonzero envelope flag would void the device-exact
+                # claim for this member; eligibility keeps schedules
+                # inside the envelope, so this raising means an engine
+                # bug.
+                receiver_mod.check_flags(mrs.flags)
+                run = receiver_mod.receiver_run_payload(mrs, mlog, cfg.n,
+                                                        cfg.ticks)
+                summaries.append(summarize(run.metrics()))
+        fold_stage_s = time.perf_counter() - t0
+        record_dispatch(
+            "per_receiver", chunk, fr,
+            {"sample": sum(sample_s[i] for i in chunk),
+             "lower": sum(lower_s[i] for i in chunk),
+             "stack": stack_s, "compile": compile_s,
+             "execute": execute_s, "fold": fold_stage_s},
+            compiled_now,
+            {"window_rows": fr * rx_w - sum(
+                rx_members[i].faults.n_windows for i in padded),
+             "fallback_instances": 0, "fallback_pids": 0})
+
+    wall_s = time.perf_counter() - t_begin
+    compile_total = sum(r["stages"]["compile"] for r in timeline)
+    device_busy_s = sum(r["stages"]["execute"] for r in timeline)
+    fold_s = sum(r["stages"]["fold"] for r in timeline)
+    host_blocked_s = max(0.0, wall_s - device_busy_s - compile_total)
 
     merged = merge_summaries(summaries)
     dists = summary_distributions(summaries)
@@ -339,8 +590,16 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
         kinds[sc.kind] = kinds.get(sc.kind, 0) + 1
 
     t0 = time.perf_counter()
-    spot = _spot_check(cfg, scenarios, referee_settings)
+    spot = _spot_check(cfg, scenarios, referee_settings, writer=writer,
+                       progress=progress)
     spot_s = time.perf_counter() - t0
+    progress.emit({"record": "campaign", "clusters_total": total,
+                   "dispatches": len(timeline),
+                   "wall_s": round(wall_s, 6),
+                   "spot_failures": spot["failed"]})
+    progress.close()
+    if writer is not None:
+        writer.write(trace_path)
 
     rx_kinds: Dict[str, int] = {}
     for i in rx_idx:
@@ -374,12 +633,35 @@ def run_campaign(cfg: CampaignConfig) -> Dict[str, object]:
         "boot_s": boot_s,
         "wall_s": wall_s,
         "fold_s": fold_s,
+        "compile_s": compile_total,
+        "device_busy_s": device_busy_s,
+        "host_blocked_s": host_blocked_s,
         "spot_check_s": spot_s,
-        "ticks_per_sec": total * cfg.ticks / wall_s if wall_s else 0.0,
-        "rounds_per_sec": merged.decisions / wall_s if wall_s else 0.0,
+        "total_s": wall_s + spot_s,
+        "ticks_per_sec": _rate(total * cfg.ticks, wall_s),
+        "rounds_per_sec": _rate(merged.decisions, wall_s),
+        "clusters_per_sec": _rate(total, wall_s),
         "announcements": merged.announcements,
         "decisions": merged.decisions,
         "telemetry": merged.as_dict(),
+        "dispatch_timeline": timeline,
+        "observatory": {
+            "host_blocked_s": host_blocked_s,
+            "device_busy_s": device_busy_s,
+            "compile_s": compile_total,
+            "host_blocked_frac": (host_blocked_s / wall_s
+                                  if wall_s >= MIN_MEASURABLE_WALL_S
+                                  else None),
+            "device_busy_frac": (device_busy_s / wall_s
+                                 if wall_s >= MIN_MEASURABLE_WALL_S
+                                 else None),
+            # What a perfect double-buffer (lower/stack dispatch d+1
+            # while d executes) could hide: the smaller of the two
+            # overlappable walls.
+            "overlap_headroom_s": min(host_blocked_s, device_busy_s),
+            "min_measurable_wall_s": MIN_MEASURABLE_WALL_S,
+            "compile": compile_info,
+        },
         "campaign": {
             "seed": cfg.seed,
             "clusters": total,
@@ -443,6 +725,15 @@ def main(argv=None) -> int:
                              "flip_flop=0,contested=1,churn=1")
     parser.add_argument("--out", type=str, default=None,
                         help="write the full payload JSON here")
+    parser.add_argument("--trace", type=str, default=None, metavar="FILE",
+                        help="write a Chrome/Perfetto trace-event JSON of "
+                             "the campaign's dispatch stages (open at "
+                             "ui.perfetto.dev)")
+    parser.add_argument("--progress", type=str, default=None,
+                        metavar="FILE",
+                        help="stream a JSONL heartbeat line per completed "
+                             "dispatch (and per spot check) to FILE; '-' "
+                             "streams to stderr")
     args = parser.parse_args(argv)
 
     cfg = CampaignConfig(clusters=args.clusters, n=args.n, ticks=args.ticks,
@@ -452,11 +743,12 @@ def main(argv=None) -> int:
                          per_receiver=not args.no_per_receiver,
                          max_spot_failures=args.max_spot_failures,
                          artifact_dir=args.spot_artifacts)
-    payload = run_campaign(cfg)
+    payload = run_campaign(cfg, trace_path=args.trace,
+                           progress_path=args.progress)
     if args.out:
-        with open(args.out, "w") as fh:
-            json.dump(payload, fh, indent=2)
-            fh.write("\n")
+        from rapid_tpu.telemetry import write_json_artifact
+
+        write_json_artifact(args.out, payload, indent=2)
     # Last stdout line is the machine-readable payload (the bench.py
     # contract); campaigns have no per-view-change rows to elide.
     print(json.dumps(payload), flush=True)

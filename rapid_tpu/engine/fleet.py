@@ -58,7 +58,8 @@ kind at lowering time:
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Sequence
+import time
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -76,11 +77,13 @@ __all__ = [
     "ReceiverBudgetError",
     "ReceiverMember",
     "check_receiver_budget",
+    "fleet_aot_compile",
     "fleet_simulate",
     "fleet_trace_count",
     "lower_receiver_schedule",
     "lower_schedule",
     "member_logs",
+    "receiver_fleet_aot_compile",
     "receiver_fleet_simulate",
     "reset_fleet_trace_count",
     "stack_members",
@@ -238,13 +241,33 @@ def _pad_fallback(sched: paxos_mod.FallbackSchedule, n_inst: int,
         table_mask=mask, table_hi=hi, table_lo=lo)
 
 
-def stack_members(members: Sequence[FleetMember]) -> FleetMember:
+def _resolve_max(requested: Optional[int], fleet_max: int,
+                 what: str) -> int:
+    if requested is None:
+        return fleet_max
+    if requested < fleet_max:
+        raise ValueError(f"{what}={requested} below the fleet max "
+                         f"{fleet_max}; padding cannot shrink")
+    return requested
+
+
+def stack_members(members: Sequence[FleetMember], *,
+                  n_windows: Optional[int] = None,
+                  n_instances: Optional[int] = None,
+                  n_pids: Optional[int] = None) -> FleetMember:
     """Stack per-cluster pytrees along a new leading fleet axis.
 
     Members must share capacity, K and fault configuration (the static
     aux data of ``EngineFaults``); link-window counts and fallback
     instance/pid counts are padded to the fleet max with inert rows so
     all treedefs (and shapes) match before ``jnp.stack``.
+
+    ``n_windows``/``n_instances``/``n_pids`` raise the padding targets
+    above this fleet's own maxima (never below). A campaign passes its
+    *global* maxima so every dispatch of a mode shares one batched
+    program shape — one XLA executable for the whole campaign instead
+    of a recompile per dispatch shape. The cost is inert padding rows,
+    which the dispatch observatory reports per dispatch.
     """
     import jax
     import jax.numpy as jnp
@@ -257,12 +280,17 @@ def stack_members(members: Sequence[FleetMember]) -> FleetMember:
             raise ValueError("fleet members must share one capacity")
         if m.churn.redraw_tick is not None:
             raise ValueError("fleet members cannot carry redraw scripts")
-    w = max(m.faults.n_windows for m in members)
-    n_inst = max(m.fallback.inst_epoch.shape[0] for m in members)
-    n_pids = max(m.fallback.table_mask.shape[1] for m in members)
+    w = _resolve_max(n_windows,
+                     max(m.faults.n_windows for m in members), "n_windows")
+    n_inst = _resolve_max(
+        n_instances, max(m.fallback.inst_epoch.shape[0] for m in members),
+        "n_instances")
+    pids = _resolve_max(
+        n_pids, max(m.fallback.table_mask.shape[1] for m in members),
+        "n_pids")
     members = [
         m._replace(faults=pad_link_windows(m.faults, w),
-                   fallback=_pad_fallback(m.fallback, n_inst, n_pids))
+                   fallback=_pad_fallback(m.fallback, n_inst, pids))
         for m in members
     ]
     return jax.tree_util.tree_map(
@@ -286,6 +314,40 @@ def fleet_simulate(fleet: FleetMember, n_ticks: int,
     """
     return _fleet_simulate(fleet.state, fleet.faults, fleet.churn,
                            fleet.fallback, int(n_ticks), settings, mesh)
+
+
+def _aot_info(lowered, lower_s: float) -> Tuple[object, Dict[str, object]]:
+    """Compile a lowered program, timing the compile separately and
+    attaching XLA's memory analysis of the executable."""
+    from rapid_tpu.telemetry.profile import compiled_memory_stats
+
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    info: Dict[str, object] = {"lower_s": round(lower_s, 6),
+                               "compile_s": round(compile_s, 6)}
+    info.update(compiled_memory_stats(compiled))
+    return compiled, info
+
+
+def fleet_aot_compile(fleet: FleetMember, n_ticks: int, settings: Settings,
+                      mesh=None) -> Tuple[object, Dict[str, object]]:
+    """AOT-compile the shared-state fleet program for ``fleet``'s shape.
+
+    Returns ``(compiled, info)``: ``compiled(state, faults, churn,
+    fallback)`` is the executable (static args baked in), ``info``
+    carries the lower/compile wall split plus XLA memory analysis
+    (``AOT_COMPILE_SPEC``). The campaign observatory uses this instead
+    of the jit cache so the first-dispatch compile cost is an explicit
+    measurement, not an inference from trace counters — every dispatch
+    of the same stacked shape reuses the executable with zero compile
+    wall.
+    """
+    t0 = time.perf_counter()
+    lowered = _fleet_simulate.lower(fleet.state, fleet.faults, fleet.churn,
+                                    fleet.fallback, int(n_ticks), settings,
+                                    mesh)
+    return _aot_info(lowered, time.perf_counter() - t0)
 
 
 def member_logs(logs, i: int):
@@ -379,14 +441,17 @@ def lower_receiver_schedule(schedule: AdversarySchedule,
     return ReceiverMember(state=state, faults=faults)
 
 
-def stack_receiver_members(members: Sequence[ReceiverMember]
+def stack_receiver_members(members: Sequence[ReceiverMember], *,
+                           n_windows: Optional[int] = None
                            ) -> ReceiverMember:
     """Stack per-receiver members along a new leading fleet axis.
 
     Same contract as ``stack_members``: shared capacity, link windows
-    padded to the fleet max with inert rows. The ``[C, C, K]`` leaves
-    become ``[F, C, C, K]`` — ``sharding.fleet_spec_for`` keeps the
-    fleet axis replicated and shards only the slot axis.
+    padded to the fleet max with inert rows (``n_windows`` raises the
+    target to a campaign-global max so all per-receiver dispatches share
+    one program shape). The ``[C, C, K]`` leaves become ``[F, C, C, K]``
+    — ``sharding.fleet_spec_for`` keeps the fleet axis replicated and
+    shards only the slot axis.
     """
     import jax
     import jax.numpy as jnp
@@ -397,7 +462,8 @@ def stack_receiver_members(members: Sequence[ReceiverMember]
     for m in members:
         if int(m.state.member.shape[0]) != c0:
             raise ValueError("fleet members must share one capacity")
-    w = max(m.faults.n_windows for m in members)
+    w = _resolve_max(n_windows,
+                     max(m.faults.n_windows for m in members), "n_windows")
     members = [m._replace(faults=pad_link_windows(m.faults, w))
                for m in members]
     return jax.tree_util.tree_map(
@@ -414,3 +480,17 @@ def receiver_fleet_simulate(fleet: ReceiverMember, n_ticks: int,
     from rapid_tpu.engine.receiver import receiver_fleet_simulate as _run
 
     return _run(fleet.state, fleet.faults, int(n_ticks), settings)
+
+
+def receiver_fleet_aot_compile(fleet: ReceiverMember, n_ticks: int,
+                               settings: Settings
+                               ) -> Tuple[object, Dict[str, object]]:
+    """AOT-compile the per-receiver fleet program (the
+    ``fleet_aot_compile`` analogue): ``compiled(state, faults)`` plus
+    the lower/compile/memory info record."""
+    from rapid_tpu.engine.receiver import _fleet_simulate as _rx_simulate
+
+    t0 = time.perf_counter()
+    lowered = _rx_simulate.lower(fleet.state, fleet.faults, int(n_ticks),
+                                 settings)
+    return _aot_info(lowered, time.perf_counter() - t0)

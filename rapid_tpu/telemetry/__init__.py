@@ -16,13 +16,56 @@ fast-round quorum progress, time-to-view-change):
   harness instead of a bare AssertionError, with a JSONL artifact.
 - ``schema`` — structural validation of BENCH payloads for the tier-1
   smoke step.
+
+Every artifact the repo writes — bench payloads, campaign payloads,
+Perfetto traces, TickMetrics streams, divergence forensics — goes
+through the two writers below, so the line-oriented contract (each file
+ends with exactly one trailing newline; ``schema.main`` rejects
+artifacts without it) is enforced in one place instead of by
+convention at every call site.
 """
-from rapid_tpu.telemetry.forensics import (
+import json as _json
+
+
+def json_artifact_line(payload, *, sort_keys: bool = False, indent=None,
+                       separators=None, default=None) -> str:
+    """One JSON document as a newline-terminated string."""
+    return _json.dumps(payload, sort_keys=sort_keys, indent=indent,
+                       separators=separators, default=default) + "\n"
+
+
+def write_json_artifact(path, payload, *, sort_keys: bool = False,
+                        indent=None, default=None) -> None:
+    """Write one JSON artifact, newline-terminated.
+
+    The single chokepoint for whole-document artifacts (bench payloads,
+    campaign payloads, trace JSON, baselines): tools that append to,
+    concatenate, or line-count these files rely on the trailing newline.
+    """
+    with open(path, "w") as fh:
+        fh.write(json_artifact_line(payload, sort_keys=sort_keys,
+                                    indent=indent, default=default))
+
+
+def write_jsonl_artifact(path, records, *, sort_keys: bool = True,
+                         default=None) -> None:
+    """Write an iterable of records as JSONL, one newline-terminated
+    line per record (TickMetrics streams, divergence forensics)."""
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json_artifact_line(rec, sort_keys=sort_keys,
+                                        default=default))
+
+
+# The writers above are defined before the submodule imports below so
+# submodules can ``from rapid_tpu.telemetry import write_json_artifact``
+# during package init without a circular-import trap.
+from rapid_tpu.telemetry.forensics import (  # noqa: E402
     Divergence,
     DivergenceError,
     DivergenceReport,
 )
-from rapid_tpu.telemetry.metrics import (
+from rapid_tpu.telemetry.metrics import (  # noqa: E402
     COUNTER_FIELDS,
     UNOBSERVED,
     RunSummary,
@@ -37,7 +80,7 @@ from rapid_tpu.telemetry.metrics import (
     summary_distributions,
     write_jsonl,
 )
-from rapid_tpu.telemetry.trace import (
+from rapid_tpu.telemetry.trace import (  # noqa: E402
     TraceWriter,
     jax_profiler_trace,
     trace_from_logs,
@@ -57,6 +100,7 @@ __all__ = [
     "engine_metrics",
     "fleet_summaries",
     "jax_profiler_trace",
+    "json_artifact_line",
     "merge_summaries",
     "oracle_metrics",
     "read_jsonl",
@@ -64,5 +108,7 @@ __all__ = [
     "summary_distributions",
     "trace_from_logs",
     "wall_span",
+    "write_json_artifact",
     "write_jsonl",
+    "write_jsonl_artifact",
 ]

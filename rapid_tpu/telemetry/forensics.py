@@ -76,11 +76,10 @@ class DivergenceReport:
 
     def write_jsonl(self, path) -> None:
         """Context records first, the divergence record last."""
-        with open(path, "w") as fh:
-            for rec in self.context:
-                fh.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
-            fh.write(json.dumps(self.as_dict(), sort_keys=True, default=str)
-                     + "\n")
+        from rapid_tpu.telemetry import write_jsonl_artifact
+
+        write_jsonl_artifact(path, [*self.context, self.as_dict()],
+                             default=str)
 
 
 class DivergenceError(AssertionError):

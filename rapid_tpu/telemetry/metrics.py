@@ -189,9 +189,9 @@ def oracle_metrics(per_tick_counters: Sequence[Dict[str, int]],
 
 
 def write_jsonl(records: Iterable[TickMetrics], path) -> None:
-    with open(path, "w") as fh:
-        for r in records:
-            fh.write(json.dumps(r.as_dict(), sort_keys=True) + "\n")
+    from rapid_tpu.telemetry import write_jsonl_artifact
+
+    write_jsonl_artifact(path, (r.as_dict() for r in records))
 
 
 def read_jsonl(path) -> List[TickMetrics]:

@@ -220,7 +220,14 @@ def _cost_entry(compiled) -> Dict[str, float]:
     return cost if isinstance(cost, dict) else {}
 
 
-def _memory_stats(compiled) -> Dict[str, int]:
+def compiled_memory_stats(compiled) -> Dict[str, int]:
+    """XLA ``memory_analysis`` of an AOT-compiled callable as plain ints.
+
+    Zeros when the backend exposes no analysis (the schema treats 0 as
+    "not measured" for these fields). Shared by the kernel observatory
+    below and the campaign dispatch observatory
+    (``engine.fleet.fleet_aot_compile``).
+    """
     out = {"argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
            "peak_bytes": 0}
     try:
@@ -247,7 +254,7 @@ def measure_kernel(name: str, fn, args, repeats: int = 5) -> KernelCost:
     compile_s = time.perf_counter() - t0
 
     cost = _cost_entry(compiled)
-    mem = _memory_stats(compiled)
+    mem = compiled_memory_stats(compiled)
 
     jax.block_until_ready(compiled(*args))  # warm the allocator
     times: List[float] = []
@@ -410,7 +417,7 @@ def receiver_memory_block(settings, n: int = 64,
         compiled = jax.jit(jax.vmap(one_tick)).lower(
             fleet.state, fleet.faults).compile()
         compile_s = time.perf_counter() - t0
-        mem = _memory_stats(compiled)
+        mem = compiled_memory_stats(compiled)
         fleets.append({"fleet_size": f, **mem,
                        "compile_s": round(compile_s, 6)})
     return {
@@ -519,8 +526,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         with open(args.merge_multichip) as fh:
             report["multichip"] = json.load(fh).get("multichip")
     if args.out:
-        with open(args.out, "w") as fh:
-            fh.write(json.dumps(report, indent=2) + "\n")
+        from rapid_tpu.telemetry import write_json_artifact
+
+        write_json_artifact(args.out, report, indent=2)
     else:
         sys.stdout.write(json.dumps(report) + "\n")
         sys.stdout.flush()

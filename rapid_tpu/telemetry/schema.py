@@ -25,7 +25,7 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 #: Fold semantics of every RunSummary gauge when aggregated over a fleet
 #: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
@@ -84,14 +84,17 @@ VIEW_CHANGE_SPEC = {
     "messages_delivered": (int,),
 }
 
-#: Required fields of one bench_engine run payload.
+#: Required fields of one bench_engine run payload. Rates are ``null``
+#: when the measured wall is below the minimum measurable floor
+#: (``campaign.MIN_MEASURABLE_WALL_S``) — a sub-millisecond wall divided
+#: into a tick count is noise, not a throughput figure.
 RUN_SPEC = {
     "bench": (str,),
     "n": (int,),
     "ticks": (int,),
     "wall_s": _NUM,
-    "ticks_per_sec": _NUM,
-    "rounds_per_sec": _NUM,
+    "ticks_per_sec": (int, float, type(None)),
+    "rounds_per_sec": (int, float, type(None)),
     "telemetry": (dict,),
 }
 
@@ -230,6 +233,93 @@ DISTRIBUTION_SPEC = {
 CAMPAIGN_DISTRIBUTIONS = ("ticks_to_first_decide", "total_sent",
                           "messages_per_view_change", "decisions")
 
+#: Per-dispatch stage keys of the campaign dispatch observatory (schema
+#: v5), in pipeline order. ``sample``/``lower`` are the host costs
+#: attributed to the dispatch's members, ``stack`` the padding+stack of
+#: the batched pytree, ``compile`` the one-time AOT lower+compile (0.0
+#: on executable-cache hits), ``execute`` the fenced device dispatch,
+#: ``fold`` the per-member summary fold.
+DISPATCH_STAGES = ("sample", "lower", "stack", "compile", "execute",
+                   "fold")
+
+#: One ``dispatch_timeline`` record (schema v5). ``wall_s`` is the sum
+#: of the stage walls by construction; ``clusters_per_sec`` is null when
+#: the dispatch wall is below the measurable floor. ``host_blocked_frac``
+#: is the fraction of the dispatch wall the host spent off-device
+#: (everything but ``execute``) — the per-dispatch double-buffering
+#: headroom signal.
+DISPATCH_RECORD_SPEC = {
+    "index": (int,),
+    "mode": (str,),
+    "members": (int,),
+    "pad_members": (int,),
+    "fleet_size": (int,),
+    "kinds": (dict,),
+    "compiled": (bool,),
+    "stages": (dict,),
+    "wall_s": _NUM,
+    "clusters_per_sec": (int, float, type(None)),
+    "host_blocked_frac": (int, float, type(None)),
+    "padding": (dict,),
+    "memory": (dict,),
+}
+
+#: Padding waste of one dispatch: inert rows added by ``stack_members``
+#: to reach the campaign-global maxima (link-window rows, fallback
+#: instance rows, fallback pid rows), summed over the fleet axis.
+DISPATCH_PADDING_SPEC = {
+    "window_rows": (int,),
+    "fallback_instances": (int,),
+    "fallback_pids": (int,),
+}
+
+#: Device-memory watermark after one dispatch. ``live_buffer_bytes``
+#: sums ``jax.live_arrays()`` (host-process-wide, so it is a watermark,
+#: not an attribution); ``device_peak_bytes`` comes from
+#: ``device.memory_stats()`` and is null on backends that expose none
+#: (CPU).
+DISPATCH_MEMORY_SPEC = {
+    "live_buffer_bytes": (int,),
+    "device_peak_bytes": _OPT_INT,
+}
+
+#: One AOT compile record (``engine.fleet.fleet_aot_compile``): the
+#: lower/compile wall split plus XLA's memory analysis of the compiled
+#: fleet program.
+AOT_COMPILE_SPEC = {
+    "lower_s": _NUM,
+    "compile_s": _NUM,
+    "argument_bytes": (int,),
+    "output_bytes": (int,),
+    "temp_bytes": (int,),
+    "peak_bytes": (int,),
+}
+
+#: Top-level ``observatory`` block of a campaign payload (schema v5):
+#: where the campaign wall actually went. ``device_busy_s`` is the
+#: fenced execute total, ``compile_s`` the one-time AOT cost,
+#: ``host_blocked_s`` everything else (sample/lower/stack/fold/glue);
+#: ``overlap_headroom_s`` = min(host_blocked_s, device_busy_s) is the
+#: wall a perfect double-buffer could hide. ``compile`` carries one
+#: AOT_COMPILE_SPEC record per dispatch mode (null when the mode never
+#: dispatched).
+OBSERVATORY_SPEC = {
+    "host_blocked_s": _NUM,
+    "device_busy_s": _NUM,
+    "compile_s": _NUM,
+    "host_blocked_frac": (int, float, type(None)),
+    "device_busy_frac": (int, float, type(None)),
+    "overlap_headroom_s": _NUM,
+    "min_measurable_wall_s": _NUM,
+    "compile": (dict,),
+}
+
+#: Relative slack allowed between a campaign payload's ``wall_s`` and
+#: the sum of its per-dispatch stage walls (timer granularity + loop
+#: glue); only enforced once the wall is comfortably measurable.
+STAGE_SUM_TOLERANCE = 0.10
+_STAGE_SUM_MIN_WALL_S = 0.05
+
 
 def _check(obj: Dict, spec: Dict, where: str) -> List[str]:
     errors = []
@@ -291,6 +381,57 @@ def validate_campaign(block, where: str = "campaign") -> List[str]:
     return errors
 
 
+def validate_dispatch_timeline(timeline, where: str = "dispatch_timeline"
+                               ) -> List[str]:
+    """Validate one campaign's per-dispatch timeline (schema v5)."""
+    errors: List[str] = []
+    if not isinstance(timeline, list):
+        return [f"{where}: expected a list, "
+                f"got {type(timeline).__name__}"]
+    for i, rec in enumerate(timeline):
+        rw = f"{where}[{i}]"
+        errors += _check(rec, DISPATCH_RECORD_SPEC, rw)
+        if not isinstance(rec, dict):
+            continue
+        if isinstance(rec.get("index"), int) and rec["index"] != i:
+            errors.append(f"{rw}.index: expected {i}, got {rec['index']}")
+        if rec.get("mode") not in ("shared", "per_receiver", None):
+            errors.append(f"{rw}.mode: expected 'shared' or "
+                          f"'per_receiver', got {rec['mode']!r}")
+        stages = rec.get("stages")
+        if isinstance(stages, dict):
+            errors += _check(stages,
+                             {s: _NUM for s in DISPATCH_STAGES},
+                             f"{rw}.stages")
+            extra = set(stages) - set(DISPATCH_STAGES)
+            for s in sorted(extra):
+                errors.append(f"{rw}.stages.{s}: unknown stage")
+        if isinstance(rec.get("padding"), dict):
+            errors += _check(rec["padding"], DISPATCH_PADDING_SPEC,
+                             f"{rw}.padding")
+        if isinstance(rec.get("memory"), dict):
+            errors += _check(rec["memory"], DISPATCH_MEMORY_SPEC,
+                             f"{rw}.memory")
+    return errors
+
+
+def validate_observatory(block, where: str = "observatory") -> List[str]:
+    errors = _check(block, OBSERVATORY_SPEC, where)
+    if not isinstance(block, dict):
+        return errors
+    compile_block = block.get("compile")
+    if isinstance(compile_block, dict):
+        for mode in ("shared", "per_receiver"):
+            if mode not in compile_block:
+                errors.append(f"{where}.compile.{mode}: missing")
+                continue
+            entry = compile_block[mode]
+            if entry is not None:  # null == that mode never dispatched
+                errors += _check(entry, AOT_COMPILE_SPEC,
+                                 f"{where}.compile.{mode}")
+    return errors
+
+
 def validate_run_payload(payload, where: str = "payload") -> List[str]:
     errors = _check(payload, RUN_SPEC, where)
     if isinstance(payload, dict) and isinstance(payload.get("telemetry"),
@@ -299,6 +440,45 @@ def validate_run_payload(payload, where: str = "payload") -> List[str]:
                                      f"{where}.telemetry")
     if isinstance(payload, dict) and "campaign" in payload:
         errors += validate_campaign(payload["campaign"], f"{where}.campaign")
+        # Schema v5: a campaign payload must carry the dispatch
+        # observatory — the per-dispatch timeline, the host/device wall
+        # accounting, and the fleet throughput figure.
+        for key, types in (("dispatch_timeline", (list,)),
+                           ("observatory", (dict,)),
+                           ("clusters_per_sec", (int, float, type(None)))):
+            if key not in payload:
+                errors.append(f"{where}.{key}: missing")
+            elif not isinstance(payload[key], types):
+                errors.append(f"{where}.{key}: expected "
+                              f"{'/'.join(t.__name__ for t in types)}, "
+                              f"got {type(payload[key]).__name__}")
+        errors += validate_dispatch_timeline(
+            payload.get("dispatch_timeline") or [],
+            f"{where}.dispatch_timeline")
+        if isinstance(payload.get("observatory"), dict):
+            errors += validate_observatory(payload["observatory"],
+                                           f"{where}.observatory")
+        # Semantic cross-check: the per-stage walls must account for the
+        # campaign wall (within tolerance) — a timeline that doesn't sum
+        # to the wall it claims to explain is instrumentation drift.
+        wall = payload.get("wall_s")
+        timeline = payload.get("dispatch_timeline")
+        if isinstance(wall, (int, float)) and not isinstance(wall, bool) \
+                and isinstance(timeline, list) \
+                and wall >= _STAGE_SUM_MIN_WALL_S:
+            stage_sum = 0.0
+            for rec in timeline:
+                if isinstance(rec, dict) and isinstance(rec.get("stages"),
+                                                        dict):
+                    stage_sum += sum(
+                        v for v in rec["stages"].values()
+                        if isinstance(v, (int, float))
+                        and not isinstance(v, bool))
+            if abs(wall - stage_sum) > STAGE_SUM_TOLERANCE * wall:
+                errors.append(
+                    f"{where}.dispatch_timeline: stage walls sum to "
+                    f"{stage_sum:.3f}s, outside ±"
+                    f"{STAGE_SUM_TOLERANCE * 100:.0f}% of wall_s={wall:.3f}s")
     return errors
 
 

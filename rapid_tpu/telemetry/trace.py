@@ -110,9 +110,9 @@ class TraceWriter:
                 "displayTimeUnit": "ms"}
 
     def write(self, path) -> None:
-        with open(path, "w") as fh:
-            json.dump(self.to_json(), fh)
-            fh.write("\n")
+        from rapid_tpu.telemetry import write_json_artifact
+
+        write_json_artifact(path, self.to_json())
 
 
 @contextmanager

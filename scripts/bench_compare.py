@@ -8,8 +8,12 @@ schedule are all seeded — so announcements, decisions, per-view-change
 message traffic, per-phase fallback counts, and invariant-violation
 counts must match the committed ``benchmarks/baseline.json`` *exactly*;
 any drift is a protocol change that either updates the baseline
-deliberately or is a bug. Wall-clock throughput is machine-dependent, so
-``ticks_per_sec`` regressions only warn (default tolerance 30%).
+deliberately or is a bug. The fleet entry's ``dispatch_timeline``
+(schema v5) splits the same way: structural fields (dispatch count,
+routing mode, kind mix, padding waste, compile-on-first-dispatch) are
+seed-deterministic and diff exactly, while stage walls stay out of the
+diff. Wall-clock throughput is machine-dependent, so ``ticks_per_sec``
+and ``clusters_per_sec`` regressions only warn (default tolerance 30%).
 
 ``kernel_profile_sweep`` payloads (``--profile-sweep``) are also
 accepted: runs are matched by ``n`` against the committed
@@ -51,6 +55,14 @@ PROTOCOL_RUN_KEYS = (
     "announcements", "decisions", "final_members", "crashed_nodes",
     "churn_bursts", "burst_size", "contested_instances",
     "ticks_to_first_decide", "messages_per_view_change",
+)
+
+#: Seed-deterministic structural fields of one dispatch_timeline record
+#: (schema v5); stage walls, rates, and memory watermarks are
+#: machine-dependent and only warn.
+DISPATCH_STRUCTURAL_KEYS = (
+    "index", "mode", "members", "pad_members", "fleet_size", "kinds",
+    "compiled", "padding",
 )
 
 #: Deterministic protocol counts inside the telemetry block, including
@@ -106,16 +118,37 @@ def compare_run(current: Dict, baseline: Dict, where: str,
                               f"{cur_c.get(key)!r} != baseline "
                               f"{base_c.get(key)!r}")
 
-    cur_tps = current.get("ticks_per_sec")
-    base_tps = baseline.get("ticks_per_sec")
-    if isinstance(cur_tps, (int, float)) and \
-            isinstance(base_tps, (int, float)) and base_tps > 0:
-        if cur_tps < base_tps * (1.0 - tps_tolerance):
-            drop = 100.0 * (1.0 - cur_tps / base_tps)
-            warnings.append(
-                f"{where}.ticks_per_sec: {cur_tps} is {drop:.0f}% below "
-                f"baseline {base_tps} (tolerance "
-                f"{tps_tolerance * 100:.0f}%)")
+    # Dispatch observatory (schema v5): the timeline's structure —
+    # dispatch count, member routing, kind mixes, padding waste, the
+    # compile-on-dispatch-0 flag — is seed-deterministic and compares
+    # exactly; stage walls, throughput rates, and memory watermarks are
+    # machine-dependent and stay out of the exact diff.
+    if "dispatch_timeline" in current or "dispatch_timeline" in baseline:
+        cur_t = current.get("dispatch_timeline") or []
+        base_t = baseline.get("dispatch_timeline") or []
+        if len(cur_t) != len(base_t):
+            errors.append(
+                f"{where}.dispatch_timeline: {len(cur_t)} dispatch "
+                f"record(s) != baseline {len(base_t)}")
+        for i, (cur_d, base_d) in enumerate(zip(cur_t, base_t)):
+            for key in DISPATCH_STRUCTURAL_KEYS:
+                if cur_d.get(key) != base_d.get(key):
+                    errors.append(
+                        f"{where}.dispatch_timeline[{i}].{key}: "
+                        f"{cur_d.get(key)!r} != baseline "
+                        f"{base_d.get(key)!r}")
+
+    for rate_key in ("ticks_per_sec", "clusters_per_sec"):
+        cur_rate = current.get(rate_key)
+        base_rate = baseline.get(rate_key)
+        if isinstance(cur_rate, (int, float)) and \
+                isinstance(base_rate, (int, float)) and base_rate > 0:
+            if cur_rate < base_rate * (1.0 - tps_tolerance):
+                drop = 100.0 * (1.0 - cur_rate / base_rate)
+                warnings.append(
+                    f"{where}.{rate_key}: {cur_rate} is {drop:.0f}% below "
+                    f"baseline {base_rate} (tolerance "
+                    f"{tps_tolerance * 100:.0f}%)")
     return errors, warnings
 
 
@@ -285,8 +318,9 @@ def main(argv=None) -> int:
         return 1
 
     if args.update_baseline:
-        with open(args.baseline, "w") as fh:
-            fh.write(json.dumps(current, indent=2) + "\n")
+        from rapid_tpu.telemetry import write_json_artifact
+
+        write_json_artifact(args.baseline, current, indent=2)
         print(f"bench_compare: baseline updated: {args.baseline}")
         return 0
 

@@ -28,7 +28,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 # (~tick 113) and the counts are non-trivial. Only meaningful when the
 # test suite itself passed.
 if [ "$rc" -eq 0 ]; then
-    if timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py \
+    if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py \
             --n 256 --ticks 120 --out /tmp/_t1_bench.json >/dev/null \
         && python -m rapid_tpu.telemetry.schema /tmp/_t1_bench.json \
         && python scripts/bench_compare.py /tmp/_t1_bench.json; then
@@ -83,6 +83,39 @@ if [ "$rc" -eq 0 ]; then
         echo FLEET_SMOKE=ok
     else
         echo FLEET_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Dispatch-observatory smoke: a small campaign run with --trace and
+# --progress must emit (a) a schema-v5-valid payload whose
+# dispatch_timeline carries per-stage walls, (b) a parseable Perfetto
+# trace-event JSON, and (c) at least one JSONL heartbeat line. The
+# schema validator already enforces the stage-sum-vs-wall_s tolerance,
+# so this step only checks the artifacts exist and parse.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 8 --fleet-size 4 --n 32 --ticks 120 \
+            --out /tmp/_t1_obs.json --trace /tmp/_t1_obs_trace.json \
+            --progress /tmp/_t1_obs_progress.jsonl >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_obs.json \
+        && python -c '
+import json, sys
+payload = json.load(open("/tmp/_t1_obs.json"))
+timeline = payload["dispatch_timeline"]
+trace = json.load(open("/tmp/_t1_obs_trace.json"))
+heartbeats = [json.loads(line) for line in
+              open("/tmp/_t1_obs_progress.jsonl") if line.strip()]
+ok = (len(timeline) >= 2
+      and timeline[0]["compiled"]
+      and any(not r["compiled"] for r in timeline[1:])
+      and payload["clusters_per_sec"] is not None
+      and len(trace.get("traceEvents", [])) > 0
+      and sum(1 for h in heartbeats if h.get("record") == "dispatch") >= 1)
+sys.exit(0 if ok else 1)'; then
+        echo OBSERVATORY_SMOKE=ok
+    else
+        echo OBSERVATORY_SMOKE=failed
         rc=1
     fi
 fi

@@ -1,34 +1,57 @@
-"""Campaign driver: determinism, schema v4 payloads, and fleet folds.
+"""Campaign driver: determinism, schema v5 payloads, and fleet folds.
 
 The campaign block of a bench payload is exact-compared by
 ``scripts/bench_compare.py``, so everything derived from the campaign
 seed — member scenarios, spot-check selection, nearest-rank
-distributions — must be bit-stable across processes and across the
-dispatch split. Wall-clock fields are the only permitted variation.
+distributions, and the structural fields of the dispatch timeline —
+must be bit-stable across processes and across the dispatch split.
+Wall-clock fields (and the per-dispatch memory watermarks, which see
+process-global allocator state) are the only permitted variation.
 """
 import copy
 import json
 
 import pytest
 
-from rapid_tpu.campaign import CampaignConfig, run_campaign
+from rapid_tpu.campaign import (MIN_MEASURABLE_WALL_S, CampaignConfig,
+                                run_campaign)
+from rapid_tpu.faults import ScenarioWeights
 from rapid_tpu.telemetry import metrics as tmetrics
 from rapid_tpu.telemetry import schema as tschema
 from rapid_tpu.telemetry.metrics import (RunSummary, merge_summaries,
                                          summary_distributions)
 
 #: Machine-dependent payload keys, excluded from determinism diffs.
-WALL_KEYS = ("boot_s", "wall_s", "fold_s", "spot_check_s", "ticks_per_sec",
-             "rounds_per_sec", "platform")
+WALL_KEYS = ("boot_s", "wall_s", "fold_s", "compile_s", "device_busy_s",
+             "host_blocked_s", "spot_check_s", "total_s", "ticks_per_sec",
+             "rounds_per_sec", "clusters_per_sec", "platform",
+             "observatory")
+
+#: Machine-dependent fields of one dispatch_timeline record; the
+#: structural remainder (index, mode, member counts, kinds, padding,
+#: compiled) is seed-deterministic and exact-compared by bench_compare.py.
+DISPATCH_WALL_KEYS = ("stages", "wall_s", "clusters_per_sec",
+                      "host_blocked_frac", "memory")
 
 TINY = CampaignConfig(clusters=6, n=16, ticks=80, seed=9, fleet_size=3,
                       headroom=8, spot_checks=0)
+
+#: Cheapest campaign whose members straddle both dispatch modes: seed 1
+#: of the crash/partition-only mix samples two crash members (shared
+#: path) and two partition members (per-receiver path).
+STRADDLE = CampaignConfig(
+    clusters=4, n=16, ticks=60, seed=1, fleet_size=2, headroom=8,
+    weights=ScenarioWeights(crash=1, partition=1, flip_flop=0,
+                            contested=0, churn=0))
 
 
 def _strip_wall(payload):
     out = copy.deepcopy(payload)
     for key in WALL_KEYS:
         out.pop(key, None)
+    for rec in out.get("dispatch_timeline", []):
+        for key in DISPATCH_WALL_KEYS:
+            rec.pop(key, None)
     return out
 
 
@@ -37,18 +60,40 @@ def tiny_payload():
     return run_campaign(TINY)
 
 
-def test_campaign_is_deterministic_across_dispatches(tiny_payload):
+def test_campaign_is_deterministic_across_dispatches(tiny_payload,
+                                                     tmp_path_factory):
     """Same seed, two runs (each split into 2 dispatches of 3): every
     non-wall field of the payload — merged telemetry, scenario-kind
-    counts, distributions — is bit-identical."""
+    counts, distributions, timeline structure — is bit-identical. The
+    second run also exercises --trace/--progress to prove the I/O knobs
+    don't perturb the campaign."""
+    tmp = tmp_path_factory.mktemp("observatory")
     assert tiny_payload["dispatches"] == 2
-    again = run_campaign(TINY)
+    again = run_campaign(TINY, trace_path=str(tmp / "trace.json"),
+                         progress_path=str(tmp / "progress.jsonl"))
     assert json.dumps(_strip_wall(tiny_payload), sort_keys=True) == \
         json.dumps(_strip_wall(again), sort_keys=True)
 
+    # Perfetto artifact: parseable, newline-terminated, non-empty.
+    raw = (tmp / "trace.json").read_bytes()
+    assert raw.endswith(b"\n")
+    trace = json.loads(raw)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert {"sample", "lower", "stack", "compile", "execute",
+            "fold"} <= names
+    # Heartbeat: one parseable line per dispatch plus the final
+    # campaign record, every line newline-terminated.
+    praw = (tmp / "progress.jsonl").read_bytes()
+    assert praw.endswith(b"\n")
+    lines = [json.loads(ln) for ln in praw.splitlines() if ln.strip()]
+    beats = [ln for ln in lines if ln["record"] == "dispatch"]
+    assert len(beats) == len(again["dispatch_timeline"])
+    assert beats[-1]["clusters_done"] == TINY.clusters
+    assert lines[-1]["record"] == "campaign"
 
-def test_campaign_payload_passes_schema_v4(tiny_payload):
-    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 4
+
+def test_campaign_payload_passes_schema_v5(tiny_payload):
+    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 5
     assert tschema.validate_bench_payload(tiny_payload) == []
     camp = tiny_payload["campaign"]
     assert camp["clusters"] == TINY.clusters
@@ -65,6 +110,112 @@ def test_campaign_payload_passes_schema_v4(tiny_payload):
     assert sum(pr["kinds"].values()) == pr["members"]
     assert pr["member_state_bytes"] > 0
     assert pr["capacity"] >= TINY.n
+
+
+def test_dispatch_timeline_observatory(tiny_payload):
+    """v5 tentpole: one record per dispatch, explicit compile split
+    (dispatch 0 pays the AOT compile, the same-shape successor is a pure
+    executable-cache hit), stage walls that reconcile with the dispatch
+    wall, and non-negative padding-waste accounting."""
+    timeline = tiny_payload["dispatch_timeline"]
+    assert len(timeline) == tiny_payload["dispatches"]
+    assert tschema.validate_dispatch_timeline(timeline) == []
+    assert sum(r["members"] for r in timeline) == TINY.clusters
+
+    first = timeline[0]
+    assert first["compiled"] is True and first["stages"]["compile"] > 0
+    later = next(r for r in timeline[1:] if r["mode"] == first["mode"])
+    assert later["compiled"] is False and later["stages"]["compile"] == 0.0
+
+    for rec in timeline:
+        stage_sum = sum(rec["stages"][s] for s in tschema.DISPATCH_STAGES)
+        assert stage_sum == pytest.approx(
+            rec["wall_s"], rel=tschema.STAGE_SUM_TOLERANCE, abs=1e-3)
+        assert rec["fleet_size"] >= rec["members"]
+        assert rec["pad_members"] == rec["fleet_size"] - rec["members"]
+        for key, val in rec["padding"].items():
+            assert isinstance(val, int) and val >= 0, (key, val)
+        if rec["host_blocked_frac"] is not None:
+            assert 0.0 <= rec["host_blocked_frac"] <= 1.0
+        assert rec["memory"]["live_buffer_bytes"] >= 0
+
+    obs = tiny_payload["observatory"]
+    assert tschema.validate_observatory(obs) == []
+    assert obs["min_measurable_wall_s"] == MIN_MEASURABLE_WALL_S
+    # The three wall components partition the campaign wall.
+    assert obs["host_blocked_s"] + obs["device_busy_s"] + obs["compile_s"] \
+        <= tiny_payload["wall_s"] + 1e-6
+    assert obs["overlap_headroom_s"] <= min(obs["host_blocked_s"],
+                                            obs["device_busy_s"]) + 1e-9
+    # TINY routes everything shared, so only that executable exists.
+    assert obs["compile"]["shared"] is not None
+    assert obs["compile"]["shared"]["compile_s"] > 0
+    assert tiny_payload["clusters_per_sec"] is not None
+    assert tiny_payload["total_s"] >= tiny_payload["wall_s"]
+
+
+def test_campaign_straddling_both_dispatch_modes():
+    """Satellite: a campaign whose members split across the shared and
+    per-receiver engines must emit one timeline record per mode, fold
+    both halves into the same distributions, and keep the member lists
+    disjoint and exhaustive."""
+    payload = run_campaign(STRADDLE)
+    assert tschema.validate_bench_payload(payload) == []
+    timeline = payload["dispatch_timeline"]
+    modes = {r["mode"] for r in timeline}
+    assert modes == {"shared", "per_receiver"}
+    assert sum(r["members"] for r in timeline) == STRADDLE.clusters
+    camp = payload["campaign"]
+    assert camp["distributions"]["clusters"] == STRADDLE.clusters
+    assert camp["per_receiver"]["members"] == 2
+    assert camp["scenario_kinds"] == {"crash": 2, "partition": 2}
+    # Both modes were compiled fresh in this process, so the observatory
+    # carries an AOT compile report for each.
+    for mode in ("shared", "per_receiver"):
+        info = payload["observatory"]["compile"][mode]
+        assert info is not None and info["compile_s"] > 0
+
+
+def test_merge_summaries_zero_decide_and_single_member():
+    """Satellite: members that never announce/decide keep their first-
+    event gauges None through the fold (min over non-None values, None
+    when no member decided), and a single-member fleet folds to itself."""
+    silent = _summary()
+    m = merge_summaries([silent, silent])
+    assert m.decisions == 0 and m.announcements == 0
+    assert m.ticks_to_first_decide is None
+    assert m.ticks_to_first_announce is None
+    assert m.messages_per_view_change is None
+
+    # One silent + one deciding member: the firsts come from the decider.
+    decider = _summary(decisions=1, announcements=1,
+                       ticks_to_first_announce=40, ticks_to_first_decide=55)
+    m = merge_summaries([silent, decider])
+    assert m.ticks_to_first_decide == 55
+    assert m.ticks_to_first_announce == 40
+
+    solo = _summary(decisions=2, total_sent=7, ticks_to_first_decide=13,
+                    fallback_phase_sent={"phase2a": 5})
+    m = merge_summaries([solo])
+    assert m.decisions == 2 and m.total_sent == 7
+    assert m.ticks_to_first_decide == 13
+    assert m.fallback_phase_sent == {"phase2a": 5}
+
+
+def test_schema_accepts_null_rates(tiny_payload):
+    """Satellite: sub-millisecond walls clamp their rates to null rather
+    than reporting astronomical throughput; the schema must accept that
+    shape at both the run and campaign level."""
+    payload = copy.deepcopy(tiny_payload)
+    payload["ticks_per_sec"] = None
+    payload["rounds_per_sec"] = None
+    payload["clusters_per_sec"] = None
+    for rec in payload["dispatch_timeline"]:
+        rec["clusters_per_sec"] = None
+        rec["host_blocked_frac"] = None
+    payload["observatory"]["host_blocked_frac"] = None
+    payload["observatory"]["device_busy_frac"] = None
+    assert tschema.validate_bench_payload(payload) == []
 
 
 def test_spot_check_graceful_degradation(monkeypatch, tmp_path):

@@ -253,6 +253,68 @@ def test_trace_export_structure(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# shared artifact writers (the trailing-newline contract)
+# ---------------------------------------------------------------------------
+
+
+def test_artifact_writers_terminate_with_newline(tmp_path):
+    """Every JSON/JSONL artifact the repo emits goes through the shared
+    writers, so the newline-termination contract is pinned here once:
+    `tail -n 1 | python -c ...` and `wc -l` must see complete lines."""
+    from rapid_tpu.telemetry import (json_artifact_line, write_json_artifact,
+                                     write_jsonl_artifact)
+
+    line = json_artifact_line({"b": 1, "a": 2}, sort_keys=True)
+    assert line.endswith("\n") and not line[:-1].endswith("\n")
+    assert json.loads(line) == {"a": 2, "b": 1}
+    assert line.index('"a"') < line.index('"b"')
+
+    path = tmp_path / "artifact.json"
+    write_json_artifact(path, {"x": [1, 2]}, indent=2)
+    raw = path.read_bytes()
+    assert raw.endswith(b"\n") and not raw.endswith(b"\n\n")
+    assert json.loads(raw) == {"x": [1, 2]}
+
+    jsonl = tmp_path / "records.jsonl"
+    write_jsonl_artifact(jsonl, ({"i": i} for i in range(3)))
+    raw = jsonl.read_bytes()
+    assert raw.endswith(b"\n")
+    rows = [json.loads(ln) for ln in raw.splitlines()]
+    assert rows == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+    # empty record streams still produce a valid (empty) artifact
+    empty = tmp_path / "empty.jsonl"
+    write_jsonl_artifact(empty, [])
+    assert empty.read_bytes() == b""
+
+
+def test_artifact_consumers_ride_the_shared_writers(tmp_path, diff_result):
+    """The migrated call sites — metrics JSONL, trace JSON, forensics
+    JSONL — all end their files with exactly one newline."""
+    from rapid_tpu.telemetry.trace import TraceWriter, wall_span
+
+    mpath = tmp_path / "metrics.jsonl"
+    write_jsonl(diff_result.engine_metrics[:4], mpath)
+    assert mpath.read_bytes().endswith(b"\n")
+
+    writer = TraceWriter()
+    with wall_span(writer, "noop", {}):
+        pass
+    tpath = tmp_path / "trace.json"
+    writer.write(tpath)
+    traw = tpath.read_bytes()
+    assert traw.endswith(b"\n") and not traw.endswith(b"\n\n")
+    json.loads(traw)
+
+    bad = copy.deepcopy(diff_result)
+    bad.engine_counters[50]["sent"] += 16
+    fpath = tmp_path / "forensics.jsonl"
+    with pytest.raises(DivergenceError):
+        bad.assert_identical(artifact=str(fpath))
+    assert fpath.read_bytes().endswith(b"\n")
+
+
+# ---------------------------------------------------------------------------
 # bench payload schema (the tier-1 smoke contract)
 # ---------------------------------------------------------------------------
 

@@ -1,13 +1,17 @@
 #!/usr/bin/env python
 """Repo-root benchmark shim: steady + churn + contested + partition
-+ fleet suite, JSON out.
++ delay + fleet suite, JSON out.
 
 This is the harness entry point (``python bench.py``): it runs the
-engine tick benchmark five times — an N=1k steady crash-burst, an N=1k
+engine tick benchmark six times — an N=1k steady crash-burst, an N=1k
 sustained-churn run, an N=1k contested-consensus run through the
 classic-Paxos fallback kernel, a small one-way-partition run
 through the fault adversary (a host-side oracle differential, so it
-uses its own ``--partition-n`` size), and a deterministic Monte-Carlo
+uses its own ``--partition-n`` size), a latency-adversary ``delay``
+campaign (every member draws from the delay/jitter/slow-asym family,
+runs device-exact through the per-receiver delivery ring, and the
+payload's ``campaign.delay_regimes`` block carries per-regime
+ticks-to-first-decide tails), and a deterministic Monte-Carlo
 ``fleet`` campaign (``--fleet-clusters`` N=``--fleet-n`` clusters with
 a mixed fault/churn sample, vmapped ``--fleet-size`` clusters per
 dispatch so the committed payload carries a multi-dispatch timeline;
@@ -46,6 +50,7 @@ from benchmarks.bench_engine import (  # noqa: E402
     run,
     run_churn,
     run_contested,
+    run_delay,
     run_fleet,
     run_partition,
 )
@@ -61,7 +66,8 @@ def _compact_payload(payload: dict) -> dict:
     artifact keeps the full rows.
     """
     out = dict(payload)
-    for key in ("steady", "churn", "contested", "partition", "fleet"):
+    for key in ("steady", "churn", "contested", "partition", "delay",
+                "fleet"):
         run_p = dict(out[key])
         tel = dict(run_p["telemetry"])
         tel["view_changes_elided"] = len(tel.get("view_changes") or [])
@@ -89,6 +95,17 @@ def main(argv=None) -> int:
                         help="ticks for the partition run (needs to "
                              "cover FD saturation plus the classic "
                              "fallback round; default 300)")
+    parser.add_argument("--delay-clusters", type=int, default=16,
+                        help="clusters in the delay campaign entry "
+                             "(latency family only, all per-receiver "
+                             "so quadratic state; default 16)")
+    parser.add_argument("--delay-n", type=int, default=48,
+                        help="members per delay-campaign cluster "
+                             "(default 48)")
+    parser.add_argument("--delay-ticks", type=int, default=240,
+                        help="ticks per delay-campaign cluster (covers "
+                             "FD saturation plus a delayed view change; "
+                             "default 240)")
     parser.add_argument("--fleet-clusters", type=int, default=128,
                         help="clusters in the fleet campaign entry "
                              "(default 128: two shared dispatches of "
@@ -124,6 +141,9 @@ def main(argv=None) -> int:
         "contested": run_contested(args.n, args.ticks, settings, args.seed),
         "partition": run_partition(args.partition_n, args.partition_ticks,
                                    settings, args.seed),
+        "delay": run_delay(args.delay_clusters, args.delay_n,
+                           args.delay_ticks, settings, args.seed,
+                           fleet_size=args.delay_clusters),
         "fleet": run_fleet(args.fleet_clusters, args.fleet_n,
                            args.fleet_ticks, settings, args.seed,
                            fleet_size=args.fleet_size),

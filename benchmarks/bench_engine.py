@@ -20,6 +20,12 @@ Two scenarios, selected with ``--scenario``:
   before reporting, so it is a correctness gate as much as a
   benchmark; it is O(n^2) per tick on the host, keep ``--n`` small
   (64-256).
+- ``delay``: a latency-adversary campaign — every sampled member draws
+  a delay-family scenario (fixed per-edge delay, bounded jitter with
+  reordering, or slow-link asymmetry) paired with a crash burst, runs
+  device-exact through the per-receiver delivery ring, and the payload
+  reports per-regime ticks-to-first-decide tails
+  (``campaign.delay_regimes``).
 
 One *gossip round* is one failure-detector interval — the period in
 which every node probes each unique subject once — i.e.
@@ -334,6 +340,31 @@ def run_partition(n: int, ticks: int, settings, seed: int = 0,
     }
 
 
+def run_delay(clusters: int, n: int, ticks: int, settings, seed: int = 0,
+              fleet_size: int = None, spot_checks: int = 0) -> dict:
+    """Latency-adversary campaign: every sampled member draws from the
+    delay family only (fixed per-edge delay, bounded jitter with
+    reordering, slow-link asymmetry), each paired with a crash burst so
+    the member decides a view change *under* latency. All members run
+    device-exact through the per-receiver delivery ring; the payload's
+    ``campaign.delay_regimes`` block reports the nearest-rank
+    ticks-to-first-decide tail per regime — the committed baseline gates
+    those tails exactly (``scripts/bench_compare.py``)."""
+    from rapid_tpu.campaign import CampaignConfig, run_campaign
+    from rapid_tpu.faults import ScenarioWeights
+
+    weights = ScenarioWeights(crash=0.0, partition=0.0, flip_flop=0.0,
+                              contested=0.0, churn=0.0,
+                              delay=1.0, jitter=1.0, slow_asym=1.0)
+    cfg = CampaignConfig(clusters=clusters, n=n, ticks=ticks, seed=seed,
+                         fleet_size=fleet_size or clusters,
+                         weights=weights, spot_checks=spot_checks,
+                         settings=settings)
+    payload = run_campaign(cfg)
+    payload["scenario"] = "delay"
+    return payload
+
+
 def run_fleet(clusters: int, n: int, ticks: int, settings, seed: int = 0,
               fleet_size: int = None, spot_checks: int = 0) -> dict:
     """Monte-Carlo fleet campaign: ``clusters`` sampled fault/churn
@@ -364,14 +395,17 @@ def main(argv=None) -> int:
                         help="tick of the correlated crash burst")
     parser.add_argument("--scenario",
                         choices=("steady", "churn", "contested",
-                                 "partition", "fleet"),
+                                 "partition", "delay", "fleet"),
                         default="steady",
                         help="steady crash-burst, sustained join/leave "
                              "churn, contested consensus through the "
                              "classic-Paxos fallback, a one-way "
                              "partition through the fault adversary "
                              "(host-side differential; keep --n small "
-                             "and --ticks >= 250), or a vmapped "
+                             "and --ticks >= 250), a latency-adversary "
+                             "campaign over the delay/jitter/slow-asym "
+                             "family (per-receiver delivery ring, "
+                             "per-regime decide tails), or a vmapped "
                              "Monte-Carlo fleet campaign over sampled "
                              "scenarios (default steady)")
     parser.add_argument("--clusters", type=int, default=64,
@@ -449,6 +483,14 @@ def main(argv=None) -> int:
                 parser.error("--trace records jitted runs; the partition "
                              "scenario is a host-side differential")
             results = [run_partition(n, args.ticks, settings, args.seed)
+                       for n in sizes]
+        elif args.scenario == "delay":
+            if writer is not None:
+                parser.error("--trace records one cluster's logs; use "
+                             "python -m rapid_tpu.campaign for fleets")
+            results = [run_delay(args.clusters, n, args.ticks, settings,
+                                 args.seed, fleet_size=args.fleet_size,
+                                 spot_checks=args.spot_checks)
                        for n in sizes]
         elif args.scenario == "fleet":
             if writer is not None:

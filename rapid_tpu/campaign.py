@@ -16,24 +16,31 @@ campaign distributions (ticks-to-decide percentiles, message-complexity
 tails, invariant-violation rates) are nearest-rank percentiles over the
 per-member summaries — bit-deterministic in the campaign seed.
 
-Exactness: partition and flip-flop members are dispatched in
-**per-receiver** mode (``engine.receiver`` via
-``fleet.lower_receiver_schedule`` / ``receiver_fleet_simulate``), so
-their reported event streams and counters are *device-exact* under link
-faults — no host replay is load-bearing for them. Crash / contested /
-churn members keep the shared-state fast path, which is exact for those
-kinds. The quadratic per-receiver state is budgeted up front
-(``fleet.check_receiver_budget``): an oversized fleet raises a
-structured ``ReceiverBudgetError`` naming the measured per-member bytes
-before any device allocation, never an OOM mid-campaign.
+Exactness: partition, flip-flop, and latency-family (delay / jitter /
+slow-asym) members are dispatched in **per-receiver** mode
+(``engine.receiver`` via ``fleet.lower_receiver_schedule`` /
+``receiver_fleet_simulate``), so their reported event streams and
+counters are *device-exact* under link faults and per-edge delay — no
+host replay is load-bearing for them. Latency members route
+per-receiver unconditionally (the shared wire has no per-edge arrival
+ticks); crash / contested / churn members keep the shared-state fast
+path, which is exact for those kinds. The quadratic per-receiver state
+is budgeted up front (``fleet.check_receiver_budget``, including the
+delivery-ring ``[D]`` axis): an oversized fleet raises a structured
+``ReceiverBudgetError`` naming the measured per-member bytes before
+any device allocation, never an OOM mid-campaign; delay schedules that
+exceed the ring depth raise ``faults.DelayBudgetError`` at sampling.
 
 Spot checks are belt-and-suspenders on top of that: a seeded subset of
-members (≥1 partition and ≥1 contested / classic-fallback scenario when
-the check budget allows) is replayed host-side through the per-slot
-oracle referee — ``diff.run_receiver_differential`` for per-receiver
-kinds, ``diff.run_adversarial_differential`` for the rest. Churn-mix
-members are excluded from the spot-check pool (the referee replays
-``AdversarySchedule`` surfaces only; churn scheduling stays
+members (≥1 partition, ≥1 contested / classic-fallback, and ≥1 delay
+scenario when the check budget allows) is replayed host-side through
+the per-slot oracle referee — ``diff.run_receiver_differential`` for
+per-receiver kinds, ``diff.run_adversarial_differential`` for the
+rest. The referee loop runs *before* the device dispatches: a
+divergence aborts the campaign without burning device wall, and every
+dispatch heartbeat carries the real running spot-failure count.
+Churn-mix members are excluded from the spot-check pool (the referee
+replays ``AdversarySchedule`` surfaces only; churn scheduling stays
 engine-side, see ``engine.churn``). A diverging check no longer kills
 the campaign outright: each failure writes a JSONL forensics artifact
 and lands as a structured record in the payload, and the run aborts
@@ -41,7 +48,7 @@ only when failures exceed ``--max-spot-failures`` (default 0 keeps the
 old strictness). This referee loop is the only host-side part of a
 campaign.
 
-Dispatch observatory (schema v5): every stage of every dispatch —
+Dispatch observatory (schema v6): every stage of every dispatch —
 schedule sampling, member lowering, ``stack_members`` padding, the
 one-time AOT XLA compile (``fleet.fleet_aot_compile``; later dispatches
 of the same mode reuse the executable with zero compile wall), the
@@ -75,16 +82,18 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from rapid_tpu import hashing
-from rapid_tpu.faults import (DEFAULT_SCENARIO_WEIGHTS, SampledScenario,
+from rapid_tpu.faults import (DEFAULT_SCENARIO_WEIGHTS, DELAY_KINDS,
+                              SCENARIO_KINDS, SampledScenario,
                               ScenarioWeights, sample_adversary_schedule)
 from rapid_tpu.settings import Settings
 
 __all__ = ["CampaignConfig", "run_campaign", "main"]
 
 #: Spot-check kinds the acceptance gate requires when the budget allows:
-#: a partition (link-masked FD path) and a contested split (classic-Paxos
-#: fallback on both sides of the differential).
-REQUIRED_SPOT_KINDS = ("partition", "contested")
+#: a partition (link-masked FD path), a contested split (classic-Paxos
+#: fallback on both sides of the differential), and a delay member (the
+#: delivery-ring latency path).
+REQUIRED_SPOT_KINDS = ("partition", "contested", "delay")
 
 #: Walls below this are timer noise on every supported platform; rates
 #: derived from them (``ticks_per_sec``, ``clusters_per_sec``) are
@@ -158,12 +167,22 @@ def _receiver_eligible(sc: SampledScenario) -> bool:
     """Per-receiver dispatch eligibility: link-fault-only members.
 
     Scripted proposes and churn are shared-path features (the
-    per-receiver envelope is crash + link windows, see
+    per-receiver envelope is crash + link windows + delay rules, see
     ``engine.receiver``); crash-only members gain nothing from the
-    quadratic state and stay on the fast path too.
+    quadratic state and stay on the fast path too. Latency-family
+    members (``DELAY_KINDS``) are eligible — and in fact *required* to
+    run per-receiver, which ``_delay_member`` enforces regardless of
+    ``CampaignConfig.per_receiver``.
     """
-    return (sc.kind in ("partition", "flip_flop")
+    return (sc.kind in ("partition", "flip_flop") + DELAY_KINDS
             and not sc.wants_churn and not sc.schedule.proposes)
+
+
+def _delay_member(sc: SampledScenario) -> bool:
+    """True for members the shared fast path cannot represent at all:
+    any schedule carrying delay rules (the shared wire has no per-edge
+    arrival ticks, ``fleet.lower_schedule`` rejects them)."""
+    return bool(sc.schedule.delays)
 
 
 def _member_seed(cfg: CampaignConfig, idx: int) -> int:
@@ -172,10 +191,15 @@ def _member_seed(cfg: CampaignConfig, idx: int) -> int:
 
 
 def _sample_scenario(cfg: CampaignConfig, idx: int) -> SampledScenario:
-    """Draw member ``idx``'s scenario (seeded by the campaign seed)."""
+    """Draw member ``idx``'s scenario (seeded by the campaign seed).
+
+    Latency draws are bounded by the campaign settings' delivery-ring
+    depth, so every sampled schedule lowers without a budget error."""
+    ring = (cfg.settings or Settings()).delivery_ring_depth
     return sample_adversary_schedule(cfg.n, _member_seed(cfg, idx),
                                      cfg.ticks,
-                                     cfg.weights or DEFAULT_SCENARIO_WEIGHTS)
+                                     cfg.weights or DEFAULT_SCENARIO_WEIGHTS,
+                                     ring_depth=ring)
 
 
 def _lower_shared(cfg: CampaignConfig, settings: Settings, idx: int,
@@ -251,10 +275,10 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
             ) & 0x7FFFFFFF
             weights = ScenarioWeights(
                 **{k: (1.0 if k == kind else 0.0)
-                   for k in ("crash", "partition", "flip_flop",
-                             "contested", "churn")})
-            forced = sample_adversary_schedule(cfg.n, forced_seed,
-                                               cfg.ticks, weights)
+                   for k in SCENARIO_KINDS})
+            forced = sample_adversary_schedule(
+                cfg.n, forced_seed, cfg.ticks, weights,
+                ring_depth=referee_settings.delivery_ring_depth)
             chosen.append((-1, forced))
     rest = [i for i in eligible if i not in used]
     rng.shuffle(rest)
@@ -263,7 +287,8 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
 
     art_dir = cfg.artifact_dir or tempfile.gettempdir()
     for idx, sc in chosen:
-        per_rx = cfg.per_receiver and _receiver_eligible(sc)
+        per_rx = ((cfg.per_receiver and _receiver_eligible(sc))
+                  or _delay_member(sc))
         runner = run_receiver_differential if per_rx \
             else run_adversarial_differential
         artifact = os.path.join(
@@ -331,19 +356,21 @@ def _device_peak_bytes(jax) -> Optional[int]:
 
 def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                  progress_path: Optional[str] = None) -> Dict[str, object]:
-    """Run one campaign; returns a schema-v5 bench run payload.
+    """Run one campaign; returns a schema-v6 bench run payload.
 
     The payload validates as an ``engine_tick`` run (``telemetry`` is the
     fleet-merged ``RunSummary``) and additionally carries the
     ``campaign`` block (scenario-kind counts, spot-check results,
-    nearest-rank distributions) plus the dispatch observatory:
+    nearest-rank distributions, per-delay-regime
+    ticks-to-first-decide tails) plus the dispatch observatory:
     ``dispatch_timeline`` (one per-stage wall record per dispatch),
     ``observatory`` (host-blocked vs device-busy vs compile wall
     accounting), and ``clusters_per_sec``. ``wall_s`` is the end-to-end
     campaign wall — sampling, lowering, stacking, the one-time AOT
     compiles, execution, and folds; the per-dispatch stage walls sum to
     it within ``schema.STAGE_SUM_TOLERANCE``. Oracle spot-check replay
-    is outside ``wall_s`` (``spot_check_s``; ``total_s`` is the sum).
+    runs first (fail-fast, before any device dispatch) and is outside
+    ``wall_s`` (``spot_check_s``; ``total_s`` is the sum).
 
     ``trace_path`` exports the stages as Perfetto wall-clock spans;
     ``progress_path`` streams a JSONL heartbeat (``-`` for stderr).
@@ -361,6 +388,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                                         stack_receiver_members)
     from rapid_tpu.telemetry.metrics import (fleet_summaries,
                                              merge_summaries,
+                                             regime_distributions,
                                              summarize,
                                              summary_distributions)
     from rapid_tpu.telemetry.schema import SCHEMA_VERSION
@@ -393,8 +421,19 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             scenarios.append(_sample_scenario(cfg, i))
             sample_s[i] = time.perf_counter() - t0
     rx_idx = [i for i, sc in enumerate(scenarios)
-              if cfg.per_receiver and _receiver_eligible(sc)]
+              if (cfg.per_receiver and _receiver_eligible(sc))
+              or _delay_member(sc)]
     sh_idx = [i for i in range(total) if i not in set(rx_idx)]
+
+    # Spot checks run *before* any device dispatch: a divergence aborts
+    # the campaign without burning device wall, and every dispatch
+    # heartbeat below can carry the real failure count instead of a
+    # placeholder. ``spot_s`` is excluded from ``wall_s`` (the referee
+    # replay is host-side work outside the campaign pipeline).
+    t0 = time.perf_counter()
+    spot = _spot_check(cfg, scenarios, referee_settings, writer=writer,
+                       progress=progress)
+    spot_s = time.perf_counter() - t0
     # Budget refusal first: an oversized per-receiver fleet raises the
     # structured ReceiverBudgetError before any member is lowered.
     fr = min(f, len(rx_idx)) if rx_idx else 0
@@ -427,6 +466,8 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     sh_pids = max((m.fallback.table_mask.shape[1]
                    for m in sh_members.values()), default=0)
     rx_w = max((m.faults.n_windows for m in rx_members.values()), default=0)
+    rx_d = max((m.faults.n_delay_rules for m in rx_members.values()),
+               default=0)
 
     fs = min(f, len(sh_idx)) if sh_idx else 0
     timeline: List[Dict[str, object]] = []
@@ -434,6 +475,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         "shared": None, "per_receiver": None}
     executables: Dict[str, object] = {}
     summaries = []
+    member_order: List[int] = []  # member index per summaries[] entry
     rx_dispatches = 0
     done = 0
 
@@ -468,7 +510,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         progress.emit({"record": "dispatch", "index": rec["index"],
                        "mode": mode, "clusters_done": done,
                        "clusters_total": total, "stages": rec["stages"],
-                       "spot_failures": 0})
+                       "spot_failures": spot["failed"]})
         return rec
 
     for chunk in _chunks(sh_idx, fs) if fs else []:
@@ -504,6 +546,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         t0 = time.perf_counter()
         with wall_span(writer, "fold", {"dispatch": d, "mode": "shared"}):
             summaries += fleet_summaries(logs)[:len(chunk)]
+            member_order += chunk
         fold_stage_s = time.perf_counter() - t0
         record_dispatch(
             "shared", chunk, fs,
@@ -519,7 +562,8 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                  for i in padded),
              "fallback_pids": fs * sh_pids - sum(
                  sh_members[i].fallback.table_mask.shape[1]
-                 for i in padded)})
+                 for i in padded),
+             "delay_rules": 0})
 
     for chunk in _chunks(rx_idx, fr) if fr else []:
         padded = chunk + [chunk[i % len(chunk)]
@@ -529,7 +573,8 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         with wall_span(writer, "stack",
                        {"dispatch": d, "mode": "per_receiver"}):
             fleet = stack_receiver_members([rx_members[i] for i in padded],
-                                           n_windows=rx_w)
+                                           n_windows=rx_w,
+                                           n_delay_rules=rx_d)
         stack_s = time.perf_counter() - t0
         compile_s = 0.0
         compiled_now = "per_receiver" not in executables
@@ -565,6 +610,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                 run = receiver_mod.receiver_run_payload(mrs, mlog, cfg.n,
                                                         cfg.ticks)
                 summaries.append(summarize(run.metrics()))
+            member_order += chunk
         fold_stage_s = time.perf_counter() - t0
         record_dispatch(
             "per_receiver", chunk, fr,
@@ -575,9 +621,14 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             compiled_now,
             {"window_rows": fr * rx_w - sum(
                 rx_members[i].faults.n_windows for i in padded),
-             "fallback_instances": 0, "fallback_pids": 0})
+             "fallback_instances": 0, "fallback_pids": 0,
+             "delay_rules": fr * rx_d - sum(
+                 rx_members[i].faults.n_delay_rules for i in padded)})
 
-    wall_s = time.perf_counter() - t_begin
+    # Spot checks ran inside the t_begin..now window but are host referee
+    # work, not campaign pipeline — subtract them so ``wall_s`` keeps its
+    # meaning (sampling + lowering + stacking + compile + execute + fold).
+    wall_s = time.perf_counter() - t_begin - spot_s
     compile_total = sum(r["stages"]["compile"] for r in timeline)
     device_busy_s = sum(r["stages"]["execute"] for r in timeline)
     fold_s = sum(r["stages"]["fold"] for r in timeline)
@@ -589,10 +640,19 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     for sc in scenarios:
         kinds[sc.kind] = kinds.get(sc.kind, 0) + 1
 
-    t0 = time.perf_counter()
-    spot = _spot_check(cfg, scenarios, referee_settings, writer=writer,
-                       progress=progress)
-    spot_s = time.perf_counter() - t0
+    # Tail-latency accounting per delay regime: every member belongs to
+    # exactly one regime (its sampled latency kind, or "no_delay"), and
+    # the block reports the nearest-rank ticks-to-first-decide tail of
+    # each regime present in the campaign.
+    regime_ticks: Dict[str, List[float]] = {}
+    for i, s in zip(member_order, summaries):
+        regime = scenarios[i].kind \
+            if scenarios[i].kind in DELAY_KINDS else "no_delay"
+        regime_ticks.setdefault(regime, [])
+        if s.ticks_to_first_decide is not None:
+            regime_ticks[regime].append(s.ticks_to_first_decide)
+    delay_regimes = regime_distributions(regime_ticks)
+
     progress.emit({"record": "campaign", "clusters_total": total,
                    "dispatches": len(timeline),
                    "wall_s": round(wall_s, 6),
@@ -613,8 +673,9 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         "fleet_size": fr,
         "capacity": rx_capacity,
         "capacity_cap": base.receiver_capacity_cap,
+        "ring_depth": base.delivery_ring_depth,
         "member_state_bytes": receiver_mod.receiver_state_bytes(
-            rx_capacity, base.K),
+            rx_capacity, base.K, ring_depth=base.delivery_ring_depth),
         "kinds": dict(sorted(rx_kinds.items())),
     }
 
@@ -671,6 +732,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             "per_receiver": per_receiver,
             "spot_checks": spot,
             "distributions": dists,
+            "delay_regimes": delay_regimes,
         },
     }
 
@@ -716,9 +778,11 @@ def main(argv=None) -> int:
                         help="directory for divergence forensics JSONL "
                              "artifacts (default: system temp dir)")
     parser.add_argument("--no-per-receiver", action="store_true",
-                        help="force every member onto the shared-state "
-                             "fast path (partition/flip-flop members "
-                             "lose the device-exact guarantee)")
+                        help="force partition/flip-flop members onto the "
+                             "shared-state fast path (losing the "
+                             "device-exact guarantee); latency-family "
+                             "members stay per-receiver regardless — the "
+                             "shared wire cannot represent delays")
     parser.add_argument("--weights", type=_parse_weights, default=None,
                         metavar="K=W,...",
                         help="scenario mix, e.g. crash=1,partition=2,"

@@ -53,7 +53,7 @@ import numpy as np
 
 from rapid_tpu import hashing
 from rapid_tpu.faults import AdversarySchedule, ScriptedPropose, \
-    validate_schedule
+    delay_of_slots, validate_schedule
 from rapid_tpu.settings import DEFAULT_SETTINGS, Settings
 
 MASK64 = hashing.MASK64
@@ -409,7 +409,14 @@ class AdversaryEngine:
         phase = _PHASE_OF.get(kind)
         if phase:
             self.phase_counters[phase + "_sent"] += 1
-        self._wire.setdefault(self.now + 1, []).append(
+        # Delay rules are evaluated at send time (latency is a property of
+        # the wire the message entered); crashes and link windows still
+        # apply at the delivery tick. Within a tick the global wseq sort
+        # keeps send order, so jittered delays reorder across ticks
+        # exactly like the oracle's per-tick in-flight buckets.
+        delay = delay_of_slots(self.schedule.delays, self.schedule.seed,
+                               src, dst, self.now)
+        self._wire.setdefault(self.now + 1 + delay, []).append(
             (next(self._wseq), src, dst, kind, payload))
 
     def _broadcast(self, src: int, kind: str, payload: tuple) -> None:

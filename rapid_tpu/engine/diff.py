@@ -916,6 +916,13 @@ def run_receiver_differential(
     Campaign spot checks call this as belt-and-suspenders; the campaign
     result itself is device-exact without it.
 
+    Delay rules are in-envelope: the schedule's ``DelayRule`` set lowers
+    to the device delivery ring (depth ``settings.delivery_ring_depth``,
+    budget-checked here before anything allocates) and the host referee
+    evaluates the identical tick-quantized send-time delay, so delayed,
+    jittered and reordered deliveries are part of the bit-exactness
+    contract, not an approximation.
+
     Scripted proposes are outside the per-receiver envelope (fleet
     lowering keeps those members on the shared-state path), and a sticky
     device flag raises :class:`rapid_tpu.engine.receiver.ReceiverEnvelopeError`
@@ -927,11 +934,11 @@ def run_receiver_differential(
     from rapid_tpu.faults import validate_schedule
     from rapid_tpu.oracle.membership_view import id_fingerprint, uid_of
 
-    validate_schedule(schedule)
+    settings = settings or Settings()
+    validate_schedule(schedule, ring_depth=settings.delivery_ring_depth)
     if schedule.proposes:
         raise ValueError("per-receiver mode does not support scripted "
                          "proposes; use run_adversarial_differential")
-    settings = settings or Settings()
     n = schedule.n
     uids = [uid_of(e) for e in default_endpoints(n)]
     id_fp_sum = sum(id_fingerprint(nid)
@@ -944,7 +951,8 @@ def run_receiver_differential(
     rs = receiver_mod.init_receiver_state(uids, id_fp_sum, settings,
                                           seed=schedule.seed)
     faults = link_faults(schedule.crash_tick_array().tolist(),
-                         schedule.windows, rs.member.shape[0])
+                         schedule.windows, rs.member.shape[0],
+                         delays=schedule.delays, delay_seed=schedule.seed)
     final, logs = receiver_mod.receiver_simulate(rs, faults, n_ticks,
                                                  settings)
     receiver_mod.check_flags(final.flags)

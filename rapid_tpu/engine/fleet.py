@@ -66,7 +66,8 @@ import numpy as np
 from rapid_tpu.engine import churn as churn_mod
 from rapid_tpu.engine import paxos as paxos_mod
 from rapid_tpu.engine.state import (EngineFaults, EngineState, init_state,
-                                    link_faults, pad_link_windows)
+                                    link_faults, pad_delay_rules,
+                                    pad_link_windows)
 from rapid_tpu.engine.step import (_fleet_simulate, fleet_trace_count,
                                    reset_fleet_trace_count)
 from rapid_tpu.faults import AdversarySchedule, validate_schedule
@@ -157,9 +158,13 @@ def lower_schedule(schedule: AdversarySchedule, settings: Settings, *,
     dormant-slot ``id_fps``) rides along; it must carry no redraw script
     (fleet members batch with one treedef) and defaults to the inert
     schedule. The universe is padded to ``settings.capacity`` when that
-    exceeds ``schedule.n``.
+    exceeds ``schedule.n``. Delay rules are per-receiver-only (the shared
+    wire has no per-edge arrival ticks) and are rejected here.
     """
     validate_schedule(schedule)
+    if schedule.delays:
+        raise ValueError("shared-state members do not support delay rules; "
+                         "lower with lower_receiver_schedule instead")
     n = schedule.n
     if uids is None:
         uids, default_sum = _default_identities(n)
@@ -396,7 +401,8 @@ def check_receiver_budget(capacity: int, fleet_size: int,
     ``settings.receiver_capacity_cap``."""
     from rapid_tpu.engine.receiver import receiver_state_bytes
 
-    member_bytes = receiver_state_bytes(capacity, settings.K)
+    member_bytes = receiver_state_bytes(
+        capacity, settings.K, ring_depth=settings.delivery_ring_depth)
     if capacity > settings.receiver_capacity_cap:
         raise ReceiverBudgetError(capacity, fleet_size,
                                   settings.receiver_capacity_cap,
@@ -415,12 +421,15 @@ def lower_receiver_schedule(schedule: AdversarySchedule,
 
     Scripted proposes and churn are shared-state-only member kinds and
     are rejected here — campaign dispatch routes them to the fast path.
+    Delay rules lower to the ``EngineFaults`` delay leaves the delivery
+    ring consumes; ``validate_schedule`` budget-checks them against
+    ``settings.delivery_ring_depth`` (structured ``DelayBudgetError``).
     The budget check runs first so oversized fleets fail structurally
     before any quadratic allocation.
     """
     from rapid_tpu.engine.receiver import init_receiver_state
 
-    validate_schedule(schedule)
+    validate_schedule(schedule, ring_depth=settings.delivery_ring_depth)
     if schedule.proposes:
         raise ValueError("per-receiver members do not support scripted "
                          "proposes; lower with lower_schedule instead")
@@ -437,21 +446,25 @@ def lower_receiver_schedule(schedule: AdversarySchedule,
     state = init_receiver_state(uids, id_fp_sum, eff, seed=schedule.seed)
     crash = np.full(c, np.iinfo(np.int32).max, np.int64)
     crash[:n] = schedule.crash_tick_array()
-    faults = link_faults(crash.tolist(), schedule.windows, c)
+    faults = link_faults(crash.tolist(), schedule.windows, c,
+                         delays=schedule.delays, delay_seed=schedule.seed)
     return ReceiverMember(state=state, faults=faults)
 
 
 def stack_receiver_members(members: Sequence[ReceiverMember], *,
-                           n_windows: Optional[int] = None
+                           n_windows: Optional[int] = None,
+                           n_delay_rules: Optional[int] = None
                            ) -> ReceiverMember:
     """Stack per-receiver members along a new leading fleet axis.
 
     Same contract as ``stack_members``: shared capacity, link windows
-    padded to the fleet max with inert rows (``n_windows`` raises the
-    target to a campaign-global max so all per-receiver dispatches share
-    one program shape). The ``[C, C, K]`` leaves become ``[F, C, C, K]``
-    — ``sharding.fleet_spec_for`` keeps the fleet axis replicated and
-    shards only the slot axis.
+    *and delay rules* padded to the fleet max with inert rows
+    (``n_windows``/``n_delay_rules`` raise the targets to campaign-global
+    maxima so all per-receiver dispatches share one program shape; an
+    inert delay rule contributes delay 0 on every edge, see
+    ``state.pad_delay_rules``). The ``[C, C, K]`` leaves become
+    ``[F, C, C, K]`` — ``sharding.fleet_spec_for`` keeps the fleet axis
+    replicated and shards only the slot axis.
     """
     import jax
     import jax.numpy as jnp
@@ -464,8 +477,12 @@ def stack_receiver_members(members: Sequence[ReceiverMember], *,
             raise ValueError("fleet members must share one capacity")
     w = _resolve_max(n_windows,
                      max(m.faults.n_windows for m in members), "n_windows")
-    members = [m._replace(faults=pad_link_windows(m.faults, w))
-               for m in members]
+    r = _resolve_max(n_delay_rules,
+                     max(m.faults.n_delay_rules for m in members),
+                     "n_delay_rules")
+    members = [m._replace(
+        faults=pad_delay_rules(pad_link_windows(m.faults, w), r))
+        for m in members]
     return jax.tree_util.tree_map(
         lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *members)
 

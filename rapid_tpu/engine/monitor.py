@@ -81,6 +81,49 @@ def link_blocked_matrix(xp, faults: EngineFaults, tick):
     return blocked
 
 
+def delay_matrix(xp, faults: EngineFaults, tick):
+    """i32 [C, C]: extra delivery delay of a message sent src->dst at
+    ``tick`` (send-time evaluation — latency is a property of the wire a
+    message entered, while crash/window masks apply at delivery).
+
+    Bit-matches ``faults.delay_of_slots``: jitter is the high limb of
+    ``hash64(src ^ hash64(dst, seed=tick), seed=schedule_seed ^ 0x6A1770)``
+    taken mod ``jitter_bound + 1`` (the seed xor is pre-materialized into
+    ``delay_seed_hi/lo`` at lowering), the forward direction of a rule
+    wins over its implied reverse, and overlapping rules combine by max.
+    The number of rules is a static python int, so R = 0 returns a
+    constant-zero matrix the compiler folds away; a padded inert rule
+    (empty slot sets, bound 0) contributes exactly 0 on every edge, which
+    is what makes fleet-stacking padding provably inert.
+    """
+    c = faults.crash_tick.shape[0]
+    total = xp.zeros((c, c), xp.int32)
+    if faults.n_delay_rules == 0:
+        return total
+    slots = xp.arange(c, dtype=xp.uint32)
+    t32 = tick.astype(xp.uint32)
+    thi, tlo = hashing.hash64_limbs_dynseed(
+        xp, xp.zeros_like(slots), slots, xp.zeros_like(t32), t32)
+    xhi = xp.broadcast_to(thi[None, :], (c, c))
+    xlo = slots[:, None] ^ tlo[None, :]
+    rhi, _ = hashing.hash64_limbs_dynseed(
+        xp, xhi, xlo, faults.delay_seed_hi, faults.delay_seed_lo)
+    for r in range(faults.n_delay_rules):
+        active = ((faults.delay_start[r] <= tick)
+                  & (tick < faults.delay_end[r]))
+        src_r, dst_r = faults.delay_src[r], faults.delay_dst[r]
+        fwd = src_r[:, None] & dst_r[None, :]
+        rev = ((faults.delay_rev[r] >= 0)
+               & (dst_r[:, None] & src_r[None, :]))
+        jit = (rhi % (faults.delay_jit[r].astype(xp.uint32)
+                      + xp.uint32(1))).astype(xp.int32)
+        d = xp.where(fwd, faults.delay_base[r] + jit,
+                     xp.where(rev,
+                              xp.maximum(faults.delay_rev[r], 0) + jit, 0))
+        total = xp.maximum(total, xp.where(active, d, 0))
+    return total
+
+
 def partitioned_edge_count(xp, faults: EngineFaults, member, tick):
     """i32 gauge: directed member->member pairs blocked by active windows.
 

@@ -5,8 +5,8 @@ and consensus copies into one — exact for crash faults, where every alive
 receiver observes the identical alert stream, but an approximation under
 ``LinkWindow`` faults, which split the receiver set. This module runs the
 protocol with *every slot carrying its own view* (``state.ReceiverState``)
-and an explicit wire (one in-flight buffer per message kind, stamped with
-the sender's cfg + recipient snapshot), evaluating link reachability at
+and an explicit wire — a bounded in-flight delivery ring, ``D`` slots
+deep, indexed by arrival tick mod D — evaluating link reachability at
 delivery per (sender, receiver) edge inside ``lax.scan`` — the same
 semantics ``engine.adversary`` replays sequentially on the host, now as a
 single XLA program that ``vmap``s over a fleet axis.
@@ -19,24 +19,50 @@ delivery, 1b during 1a delivery, votes during batch delivery (announce),
 then ``_run_due``: 1a from timers, batches from batchers — so deliveries
 at ``t`` group exactly as ``2b, 2a, 1b, vote, 1a, batch``, which is the
 phase order of :func:`receiver_step`. Within a group, arrival order is
-recovered from announce-order keys (``t*(C+1) + ring0 position``): the
-oracle's scheduler handles are creation-ordered, and every racing sender
-acquired its key at announce time. Order-dependent triggers (fast-vote
-quorum crossing, 1a rank prefix-max, 1b majority crossing + value
-selection, ascending-rank 2a accept chains) are evaluated as prefix
-reductions over that order — exact, not approximate, for the scenarios
-the differential suite pins (see ``Envelope`` below).
+recovered from keys stamped at send time: send tick first (delay rules
+let messages from different ticks share an arrival tick), then the
+announce-order key ``t*(C+1) + ring0 position`` — the oracle's scheduler
+handles are creation-ordered, and every racing sender acquired its key
+at announce time. Order-dependent triggers (fast-vote quorum crossing,
+1a rank prefix-max, 1b majority crossing + value selection,
+ascending-rank 2a accept chains) are evaluated as prefix reductions over
+that order — exact, not approximate, for the scenarios the differential
+suite pins (see ``Envelope`` below).
+
+Delivery ring
+-------------
+A message sent at tick ``t`` on an edge with delay ``d`` (from
+``monitor.delay_matrix``, evaluated at *send* time) lands in ring slot
+``(t + 1 + d) % D`` and is read back at tick ``t + 1 + d``; arrival
+ticks within the D-deep window map to distinct slots, so the largest
+representable extra delay is ``D - 1`` (``D =
+Settings.delivery_ring_depth``, budget-checked up front by
+``faults.validate_schedule``). Per-edge jitter legally splits one
+broadcast across ring slots — the recipient fan is resolved into the
+``[D, C, C]`` presence rings at send. ``D = 1`` with no delay rules is
+bit-for-bit the old next-tick wire.
 
 Envelope
 --------
 Supported fault inputs: crash schedules plus arbitrary ``LinkWindow``
-sets (one-way/two-way, flip-flop periods). Scripted proposes and churn
-are *not* supported — fleet lowering keeps those member kinds on the
-shared-state fast path. Deep races outside the committed differential
-envelope set sticky ``flags`` bits rather than silently diverging:
-multiple tracked 2b rounds per listener, more than two same-tick 2a
-accepts per acceptor, a proposal fingerprint missing from the announce
-registry, or a slot exhausting its precomputed fallback-delay draws.
+sets (one-way/two-way, flip-flop periods) plus ``DelayRule`` sets
+(per-edge delay, bounded jitter, asymmetric reverse paths — and the
+message reordering they induce). Scripted proposes and churn are *not*
+supported — fleet lowering keeps those member kinds on the shared-state
+fast path. Deep races outside the committed differential envelope set
+sticky ``flags`` bits rather than silently diverging: multiple tracked
+2b rounds per listener, more than two same-tick 2a accepts per acceptor,
+a proposal fingerprint missing from the announce registry, a slot
+exhausting its precomputed fallback-delay draws, two same-kind
+messages from one sender jittered onto the same arrival tick (the ring
+holds one payload per (slot, sender)), or a cross-phase send-order
+inversion — a delayed message (say a jittered fast vote) landing on the
+same arrival tick as a *later-sent* message of an earlier-processed
+group, where the fixed group order above stops matching oracle wseq
+order. Campaign-sampled delays cannot reach that corner: the ring
+budget caps them at ``D - 1`` ticks while classic traffic starts no
+earlier than ``fallback_base_delay_ticks`` after the votes it could
+race.
 ``diff.run_receiver_differential`` asserts the flags stay zero for every
 scenario it verifies.
 
@@ -72,6 +98,8 @@ FLAG_DRAWS_EXHAUSTED = 2
 FLAG_MULTI_2A_ACCEPTS = 4     # >2 same-tick ascending-rank accepts
 FLAG_MULTI_2B_ROUNDS = 8      # 2b traffic across distinct rounds
 FLAG_REGISTRY_MISS = 16       # vote/2a fingerprint not in announce registry
+FLAG_RING_COLLISION = 32      # same-kind same-sender same-arrival-tick pair
+FLAG_CROSS_PHASE_REORDER = 64  # older send arrived behind a fresher group
 
 _FLAG_NAMES = {
     FLAG_DECIDE_NOT_IN_VIEW: "decide-host-not-in-view",
@@ -79,6 +107,8 @@ _FLAG_NAMES = {
     FLAG_MULTI_2A_ACCEPTS: "more-than-two-same-tick-2a-accepts",
     FLAG_MULTI_2B_ROUNDS: "multiple-2b-rounds-tracked",
     FLAG_REGISTRY_MISS: "proposal-registry-miss",
+    FLAG_RING_COLLISION: "delivery-ring-collision",
+    FLAG_CROSS_PHASE_REORDER: "cross-phase-send-order-inversion",
 }
 
 
@@ -151,6 +181,18 @@ def _pick_min_seq(xp, mask, seqs):
     """Per row: index of the mask element with the smallest seq key."""
     keyed = xp.where(mask, seqs, I32_MAX)
     return xp.argmin(keyed, axis=1), mask.any(axis=1)
+
+
+def _arrival_perm(xp, present, ticks, seqs):
+    """Sender permutation recovering oracle wseq order for one ring slot:
+    ascending send tick first (delayed links let sends from different
+    ticks share an arrival tick), then the stamped within-tick key;
+    absent senders sort last. Both argsorts are stable, so with a single
+    send tick in the slot (always true at D = 1) this degenerates to the
+    plain within-tick key sort."""
+    p1 = xp.argsort(xp.where(present, seqs, I32_MAX))
+    k2 = xp.where(present, ticks, I32_MAX)[p1]
+    return p1[xp.argsort(k2, stable=True)]
 
 
 class _Vars:
@@ -230,6 +272,8 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     jidx = ridx
     crashed = monitor.crashed_at(faults, t)
     emat = monitor.link_blocked_matrix(xp, faults, t)
+    D = settings.delivery_ring_depth
+    am = t % D                  # ring slot arriving this tick
     i32 = lambda x: xp.int32(x)
     pop = lambda m: m.sum(axis=1).astype(xp.int32)   # popcount of mask rows
 
@@ -263,21 +307,26 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
         dec_cfg_lo = xp.where(dm, cfg_lo, dec_cfg_lo)
 
     # ---- group 1: phase-2b delivery -> decide wave A --------------------
+    w2b_ring = rs.w2b[am]
+    w2b_rnd_r = rs.w2b_rnd[am]
+    w2b_mask_r = rs.w2b_mask[am]
+    w2b_cfg_hi_r, w2b_cfg_lo_r = rs.w2b_cfg_hi[am], rs.w2b_cfg_lo[am]
     gates = []
     for slot in (0, 1):
-        msgs = rs.w2b[slot][:, None] & rs.w2b_bcast
+        msgs = w2b_ring[slot]
         dv = deliver(msgs, "p2b")
         arr = dv.T
         gates.append(arr & ~v.stopped[:, None]
-                     & _cfg_eq(rs.w2b_cfg_hi[None, :], rs.w2b_cfg_lo[None, :],
+                     & _cfg_eq(w2b_cfg_hi_r[None, :], w2b_cfg_lo_r[None, :],
                                v.cfg_hi[:, None], v.cfg_lo[:, None]))
-    rnd0 = xp.where(gates[0], rs.w2b_rnd[0][None, :], -1)
-    rnd1 = xp.where(gates[1], rs.w2b_rnd[1][None, :], -1)
+    g2b_any = (gates[0] | gates[1]).any(axis=1)
+    rnd0 = xp.where(gates[0], w2b_rnd_r[0][None, :], -1)
+    rnd1 = xp.where(gates[1], w2b_rnd_r[1][None, :], -1)
     mx_in = xp.maximum(rnd0.max(axis=1), rnd1.max(axis=1))
     mx = xp.maximum(v.p2_rnd, mx_in)
     reset = mx > v.p2_rnd
-    use0 = gates[0] & (rs.w2b_rnd[0][None, :] == mx[:, None])
-    use1 = gates[1] & (rs.w2b_rnd[1][None, :] == mx[:, None])
+    use0 = gates[0] & (w2b_rnd_r[0][None, :] == mx[:, None])
+    use1 = gates[1] & (w2b_rnd_r[1][None, :] == mx[:, None])
     low_seen = ((gates[0] & ~use0).any() | (gates[1] & ~use1).any()
                 | (reset & (v.p2_rnd >= 0) & v.p2_seen.any(axis=1)).any())
     v.flags = v.flags | xp.where(low_seen, FLAG_MULTI_2B_ROUNDS, 0)
@@ -286,8 +335,8 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     v.p2_seen = seen_base | add
     a_star = xp.argmax(add, axis=1)
     pick0 = use0[ridx, a_star]
-    gathered = xp.where(pick0[:, None], rs.w2b_mask[0][a_star],
-                        rs.w2b_mask[1][a_star])
+    gathered = xp.where(pick0[:, None], w2b_mask_r[0][a_star],
+                        w2b_mask_r[1][a_star])
     refresh = reset & add.any(axis=1)
     v.p2_mask = xp.where(refresh[:, None], gathered, v.p2_mask)
     v.p2_rnd = mx
@@ -299,13 +348,19 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     record_decides(dec_a, hosts_a, ncfg_hi, ncfg_lo)
 
     # ---- group 3: phase-2a delivery -> accept chain -> 2b emission ------
-    msgs = rs.w2a[:, None] & rs.w2a_bcast
+    w2a_ring = rs.w2a[am]
+    w2a_fp_hi_r, w2a_fp_lo_r = rs.w2a_fp_hi[am], rs.w2a_fp_lo[am]
+    w2a_mask_arr = rs.w2a_mask[am]
+    msgs = w2a_ring
     dv = deliver(msgs, "p2a")
     arr = dv.T
     gate = (arr & ~v.stopped[:, None]
-            & _cfg_eq(rs.w2a_cfg_hi[None, :], rs.w2a_cfg_lo[None, :],
+            & _cfg_eq(rs.w2a_cfg_hi[am][None, :], rs.w2a_cfg_lo[am][None, :],
                       v.cfg_hi[:, None], v.cfg_lo[:, None]))
-    perm3 = xp.argsort(xp.where(rs.w2a, rs.w2a_seq, I32_MAX))
+    send2a_min = xp.where(gate, rs.w2a_tick[am][None, :], I32_MAX).min(axis=1)
+    send2a_max = xp.where(gate, rs.w2a_tick[am][None, :], -1).max(axis=1)
+    perm3 = _arrival_perm(xp, w2a_ring.any(axis=1),
+                          rs.w2a_tick[am], rs.w2a_seq[am])
     gate_s = gate[:, perm3]
     rank_j = rs.rank_idx[perm3]
     ge0 = ((v.px_rnd_r[:, None] < 2)
@@ -323,13 +378,15 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     c1, c2, cl = perm3[j1], perm3[j2], perm3[jl]
     emit0 = n_acc >= 1
     emit1 = n_acc >= 2
-    w2b_new = xp.stack([emit0, emit1])
     w2b_rnd_new = xp.stack([rs.rank_idx[c1], rs.rank_idx[c2]])
-    w2b_fp_hi_new = xp.stack([rs.w2a_fp_hi[c1], rs.w2a_fp_hi[c2]])
-    w2b_fp_lo_new = xp.stack([rs.w2a_fp_lo[c1], rs.w2a_fp_lo[c2]])
-    w2b_mask_new = xp.stack([rs.w2a_mask[c1], rs.w2a_mask[c2]])
+    w2b_fp_hi_new = xp.stack([w2a_fp_hi_r[c1], w2a_fp_hi_r[c2]])
+    w2b_fp_lo_new = xp.stack([w2a_fp_lo_r[c1], w2a_fp_lo_r[c2]])
+    w2b_mask_new = xp.stack([w2a_mask_arr[c1], w2a_mask_arr[c2]])
     w2b_cfg_hi_new, w2b_cfg_lo_new = v.cfg_hi, v.cfg_lo
-    w2b_bcast_new = v.member
+    # Recipient snapshot captured here: wave-B decides below must not
+    # retroactively shrink this tick's fan (oracle sends 2b during 2a
+    # delivery, before votes are processed).
+    w2b_fan = xp.stack([emit0[:, None] & v.member, emit1[:, None] & v.member])
     n_2b = (emit0 * pop(v.member) + emit1 * pop(v.member)).sum().astype(
         xp.int32)
     phase_sent["p2b"] += n_2b
@@ -339,40 +396,45 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     v.px_rnd_i = xp.where(emit0, rank_last, v.px_rnd_i)
     v.px_vrnd_r = xp.where(emit0, 2, v.px_vrnd_r)
     v.px_vrnd_i = xp.where(emit0, rank_last, v.px_vrnd_i)
-    v.px_vv_fp_hi = xp.where(emit0, rs.w2a_fp_hi[cl], v.px_vv_fp_hi)
-    v.px_vv_fp_lo = xp.where(emit0, rs.w2a_fp_lo[cl], v.px_vv_fp_lo)
+    v.px_vv_fp_hi = xp.where(emit0, w2a_fp_hi_r[cl], v.px_vv_fp_hi)
+    v.px_vv_fp_lo = xp.where(emit0, w2a_fp_lo_r[cl], v.px_vv_fp_lo)
     v.px_vv_set = v.px_vv_set | emit0
 
     # ---- group 4: phase-1b delivery -> crossing + selection -> 2a -------
-    msgs = rs.w1b
+    w1b_ring = rs.w1b[am]
+    w1b_set_r = rs.w1b_set[am]
+    msgs = w1b_ring
     dv = deliver(msgs, "p1b")
     arr = dv.T                                   # [coordinator, promiser]
     gate = (arr & ~v.stopped[:, None] & (v.px_crnd_r[:, None] == 2)
-            & _cfg_eq(rs.w1b_cfg_hi[None, :], rs.w1b_cfg_lo[None, :],
+            & _cfg_eq(rs.w1b_cfg_hi[am][None, :], rs.w1b_cfg_lo[am][None, :],
                       v.cfg_hi[:, None], v.cfg_lo[:, None]))
     new = gate & ~v.pb_seen
-    seq_in = t * (c + 1) + rs.rx_pos
+    seq_in = rs.w1b_seq[am]      # send key: tick*(C+1) + promiser rx_pos
+    t1b = seq_in // (c + 1)
+    send1b_min = xp.where(new, t1b[None, :], I32_MAX).min(axis=1)
+    send1b_max = xp.where(new, t1b[None, :], -1).max(axis=1)
     v.pb_seen = v.pb_seen | new
-    v.pb_vrnd_r = xp.where(new, rs.w1b_vrnd_r[None, :], v.pb_vrnd_r)
-    v.pb_vrnd_i = xp.where(new, rs.w1b_vrnd_i[None, :], v.pb_vrnd_i)
-    v.pb_fp_hi = xp.where(new, rs.w1b_fp_hi[None, :], v.pb_fp_hi)
-    v.pb_fp_lo = xp.where(new, rs.w1b_fp_lo[None, :], v.pb_fp_lo)
-    v.pb_set = xp.where(new, rs.w1b_set[None, :], v.pb_set)
+    v.pb_vrnd_r = xp.where(new, rs.w1b_vrnd_r[am][None, :], v.pb_vrnd_r)
+    v.pb_vrnd_i = xp.where(new, rs.w1b_vrnd_i[am][None, :], v.pb_vrnd_i)
+    v.pb_fp_hi = xp.where(new, rs.w1b_fp_hi[am][None, :], v.pb_fp_hi)
+    v.pb_fp_lo = xp.where(new, rs.w1b_fp_lo[am][None, :], v.pb_fp_lo)
+    v.pb_set = xp.where(new, w1b_set_r[None, :], v.pb_set)
     v.pb_seq = xp.where(new, seq_in[None, :], v.pb_seq)
 
     prior = v.pb_seen & ~new
     prior_tot = prior.sum(axis=1).astype(xp.int32)
     prior_ne = (prior & v.pb_set).sum(axis=1).astype(xp.int32)
-    perm2 = xp.argsort(rs.rx_pos)
+    perm2 = xp.argsort(xp.where(w1b_ring.any(axis=1), seq_in, I32_MAX))
     new_s = new[:, perm2]
-    ne_new_s = new_s & rs.w1b_set[perm2][None, :]
+    ne_new_s = new_s & w1b_set_r[perm2][None, :]
     cum_tot = prior_tot[:, None] + xp.cumsum(new_s, axis=1)
     cum_ne = prior_ne[:, None] + xp.cumsum(ne_new_s, axis=1)
     thr = v.px_n // 2 + 1
     elig = new_s & (cum_tot >= thr[:, None]) & (cum_ne >= 1)
     cross = elig.any(axis=1) & ~v.px_cval_set
     jstar = xp.argmax(elig, axis=1)
-    sstar = t * (c + 1) + rs.rx_pos[perm2[jstar]]
+    sstar = seq_in[perm2[jstar]]
     prefix = v.pb_seen & (v.pb_seq <= sstar[:, None])
 
     vr = xp.where(prefix, v.pb_vrnd_r, -1)
@@ -400,39 +462,48 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
         xp, v.reg_valid, v.reg_mask, v.reg_fp_hi, v.reg_fp_lo,
         chosen_fp_hi, chosen_fp_lo, cross)
     v.flags = v.flags | xp.where(miss, FLAG_REGISTRY_MISS, 0)
-    w2a_new = cross
     w2a_fp_hi_new = xp.where(cross, chosen_fp_hi, 0).astype(xp.uint32)
     w2a_fp_lo_new = xp.where(cross, chosen_fp_lo, 0).astype(xp.uint32)
     w2a_mask_new = res_mask
     w2a_cfg_hi_new, w2a_cfg_lo_new = v.cfg_hi, v.cfg_lo
     w2a_seq_new = v.ar_seq
-    w2a_bcast_new = v.member
+    # Snapshot before wave-B decides can shrink the view (oracle sends 2a
+    # during 1b delivery, ahead of this tick's votes).
+    w2a_fan = cross[:, None] & v.member
     v.px_cval_set = v.px_cval_set | cross
     n_2a = (cross * pop(v.member)).sum().astype(xp.int32)
     phase_sent["p2a"] += n_2a
     sent += n_2a
 
     # ---- group 5: fast-vote delivery -> decide wave B -------------------
-    msgs = rs.wv[:, None] & rs.wv_bcast
+    # Vote seq keys are announce keys, and a vote is sent at its announce
+    # tick, so the single stamped sort is already send-tick-major.
+    wv_ring = rs.wv[am]
+    wv_fp_hi_r, wv_fp_lo_r = rs.wv_fp_hi[am], rs.wv_fp_lo[am]
+    msgs = wv_ring
     dv = deliver(msgs, "fv")
     arr = dv.T
     gate = (arr & ~v.stopped[:, None]
-            & _cfg_eq(rs.wv_cfg_hi[None, :], rs.wv_cfg_lo[None, :],
+            & _cfg_eq(rs.wv_cfg_hi[am][None, :], rs.wv_cfg_lo[am][None, :],
                       v.cfg_hi[:, None], v.cfg_lo[:, None]))
     process = gate & ~v.vt_seen
-    perm_v = xp.argsort(xp.where(rs.wv, rs.wv_seq, I32_MAX))
+    # A vote's send tick is its announce tick (votes broadcast at announce).
+    tv = rs.wv_seq[am] // (c + 1)
+    sendv_min = xp.where(process, tv[None, :], I32_MAX).min(axis=1)
+    sendv_max = xp.where(process, tv[None, :], -1).max(axis=1)
+    perm_v = xp.argsort(xp.where(wv_ring.any(axis=1), rs.wv_seq[am], I32_MAX))
     proc_s = process[:, perm_v]
     # Baseline: stored votes equal to each arriving fingerprint.
-    fp_eq_stored = ((v.vt_fp_hi[:, :, None] == rs.wv_fp_hi[perm_v][None, None, :])
+    fp_eq_stored = ((v.vt_fp_hi[:, :, None] == wv_fp_hi_r[perm_v][None, None, :])
                     & (v.vt_fp_lo[:, :, None]
-                       == rs.wv_fp_lo[perm_v][None, None, :]))
+                       == wv_fp_lo_r[perm_v][None, None, :]))
     baseline = (v.vt_seen[:, :, None] & fp_eq_stored).sum(axis=1).astype(
         xp.int32)
     prior_tot = v.vt_seen.sum(axis=1).astype(xp.int32)
     # Arrival-prefix counts of equal fingerprints, in announce order.
-    fp_eq_wire = ((rs.wv_fp_hi[perm_v][:, None] == rs.wv_fp_hi[perm_v][None, :])
-                  & (rs.wv_fp_lo[perm_v][:, None]
-                     == rs.wv_fp_lo[perm_v][None, :]))
+    fp_eq_wire = ((wv_fp_hi_r[perm_v][:, None] == wv_fp_hi_r[perm_v][None, :])
+                  & (wv_fp_lo_r[perm_v][:, None]
+                     == wv_fp_lo_r[perm_v][None, :]))
     lower_tri = jidx[None, :] <= jidx[:, None]          # [j, j2]: j2 <= j
     prefix_cnt = xp.einsum('rj,kj->rk', proc_s.astype(xp.int32),
                            (fp_eq_wire & lower_tri).astype(xp.int32))
@@ -443,28 +514,31 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
             & (total_after >= quorum[:, None]))
     dec_b = trig.any(axis=1)
     win_j = xp.argmax(trig, axis=1)
-    win_fp_hi = rs.wv_fp_hi[perm_v[win_j]]
-    win_fp_lo = rs.wv_fp_lo[perm_v[win_j]]
+    win_fp_hi = wv_fp_hi_r[perm_v[win_j]]
+    win_fp_lo = wv_fp_lo_r[perm_v[win_j]]
     hosts_b, _, miss = _registry_lookup(
         xp, v.reg_valid, v.reg_mask, v.reg_fp_hi, v.reg_fp_lo,
         win_fp_hi, win_fp_lo, dec_b)
     v.flags = v.flags | xp.where(miss, FLAG_REGISTRY_MISS, 0)
     v.vt_seen = v.vt_seen | process
-    v.vt_fp_hi = xp.where(process, rs.wv_fp_hi[None, :], v.vt_fp_hi)
-    v.vt_fp_lo = xp.where(process, rs.wv_fp_lo[None, :], v.vt_fp_lo)
+    v.vt_fp_hi = xp.where(process, wv_fp_hi_r[None, :], v.vt_fp_hi)
+    v.vt_fp_lo = xp.where(process, wv_fp_lo_r[None, :], v.vt_fp_lo)
 
     # ---- group 6: apply decide wave B -----------------------------------
     ncfg_hi, ncfg_lo = _apply_decides(xp, v, t, dec_b, hosts_b)
     record_decides(dec_b, hosts_b, ncfg_hi, ncfg_lo)
 
     # ---- group 7: phase-1a delivery -> promises -> 1b emission ----------
-    msgs = rs.w1a[:, None] & rs.w1a_bcast
+    w1a_ring = rs.w1a[am]
+    msgs = w1a_ring
     dv = deliver(msgs, "p1a")
     arr = dv.T                                   # [promiser, coordinator]
     gate = (arr & ~v.stopped[:, None]
-            & _cfg_eq(rs.w1a_cfg_hi[None, :], rs.w1a_cfg_lo[None, :],
+            & _cfg_eq(rs.w1a_cfg_hi[am][None, :], rs.w1a_cfg_lo[am][None, :],
                       v.cfg_hi[:, None], v.cfg_lo[:, None]))
-    perm1 = xp.argsort(xp.where(rs.w1a, rs.w1a_seq, I32_MAX))
+    send1a_min = xp.where(gate, rs.w1a_tick[am][None, :], I32_MAX).min(axis=1)
+    perm1 = _arrival_perm(xp, w1a_ring.any(axis=1),
+                          rs.w1a_tick[am], rs.w1a_seq[am])
     gate_s = gate[:, perm1]
     rank_j = rs.rank_idx[perm1]
     above_cur = ((v.px_rnd_r[:, None] < 2)
@@ -488,13 +562,34 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     phase_sent["p1b"] += n_1b
     sent += n_1b
 
+    # ---- cross-phase send-order guard -----------------------------------
+    # The fixed group order above equals oracle wseq order only while all
+    # of a tick's processed arrivals left the wire on the same tick. A
+    # delay rule can land an older send on the same arrival tick as a
+    # fresher message of an earlier-processed group — the oracle delivers
+    # the older send first, this kernel cannot, so the inversion sets a
+    # sticky flag instead of silently diverging. 2b payloads carry no
+    # send stamp: a gated 2b arrival counts as sent at t-1, the
+    # conservative maximum.
+    run_max = xp.where(g2b_any, t - 1, -1)
+    inv = send2a_min < run_max
+    run_max = xp.maximum(run_max, send2a_max)
+    inv |= send1b_min < run_max
+    run_max = xp.maximum(run_max, send1b_max)
+    inv |= sendv_min < run_max
+    run_max = xp.maximum(run_max, sendv_max)
+    inv |= send1a_min < run_max
+    v.flags = v.flags | xp.where(inv.any(), FLAG_CROSS_PHASE_REORDER, 0)
+
     # ---- group 8: batch delivery -> cut aggregation -> announce ---------
-    msgs = rs.pd.any(axis=1)[:, None] & rs.pd_bcast
+    pd_ring = rs.pd[am]
+    msgs = pd_ring.any(axis=1)[:, None] & rs.pd_bcast[am]
     dv = deliver(msgs)
     recv = (dv.T & ~v.stopped[:, None] & ~v.announced[:, None]
-            & _cfg_eq(rs.pd_cfg_hi[None, :], rs.pd_cfg_lo[None, :],
+            & _cfg_eq(rs.pd_cfg_hi[am][None, :], rs.pd_cfg_lo[am][None, :],
                       v.cfg_hi[:, None], v.cfg_lo[:, None]))
-    onehot = (rs.pd[:, :, None] & (rs.pd_dst[:, :, None] == ridx[None, None, :]))
+    onehot = (pd_ring[:, :, None]
+              & (rs.pd_dst[am][:, :, None] == ridx[None, None, :]))
     down = xp.einsum('rs,skd->rdk', recv.astype(xp.int32),
                      onehot.astype(xp.int32)) > 0
     gate8 = ~v.announced & ~v.stopped
@@ -511,12 +606,11 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     v.reg_mask = xp.where(announce[:, None], crossed, v.reg_mask)
     v.reg_fp_hi = xp.where(announce, prop_fp_hi, v.reg_fp_hi)
     v.reg_fp_lo = xp.where(announce, prop_fp_lo, v.reg_fp_lo)
-    wv_new = announce
     wv_fp_hi_new = xp.where(announce, prop_fp_hi, 0).astype(xp.uint32)
     wv_fp_lo_new = xp.where(announce, prop_fp_lo, 0).astype(xp.uint32)
     wv_cfg_hi_new, wv_cfg_lo_new = v.cfg_hi, v.cfg_lo
     wv_seq_new = v.ar_seq
-    wv_bcast_new = v.member
+    wv_fan = announce[:, None] & v.member
     n_fv = (announce * pop(v.member)).sum().astype(xp.int32)
     phase_sent["fv"] += n_fv
     sent += n_fv
@@ -546,10 +640,9 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     fire = v.px_timer == t
     v.px_crnd_r = xp.where(fire, 2, v.px_crnd_r)
     v.px_timer = xp.where(fire, I32_MAX, v.px_timer)
-    w1a_new = fire
     w1a_cfg_hi_new, w1a_cfg_lo_new = v.cfg_hi, v.cfg_lo
     w1a_seq_new = v.ar_seq
-    w1a_bcast_new = v.member
+    w1a_fan = fire[:, None] & v.member
     n_1a = (fire * pop(v.member)).sum().astype(xp.int32)
     phase_sent["p1a"] += n_1a
     sent += n_1a
@@ -573,7 +666,7 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     pd_new = rs.pf & flush[:, None]
     pd_dst_new = rs.pf_dst
     pd_cfg_hi_new, pd_cfg_lo_new = rs.pf_cfg_hi, rs.pf_cfg_lo
-    pd_bcast_new = v.member
+    pd_fan = flush[:, None] & v.member
     sent += (flush * pop(v.member)).sum().astype(xp.int32)
     v.pf = pf_new
     v.pf_dst = v.own_subj
@@ -601,30 +694,94 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     (v.obs_full, v.own_subj, v.own_fd_active, v.own_fd_first,
      v.rx_pos) = lax.cond(dec_mask.any(), _rebuild, _keep, v.member)
 
-    # ---- finalize --------------------------------------------------------
+    # ---- finalize: rotate the delivery ring ------------------------------
+    # Messages sent this tick land in ring slot (t + 1 + delay) % D, the
+    # per-edge delay evaluated at *send* time (latency is a property of
+    # the wire a message entered; the crash/window masks above applied at
+    # delivery). Slot ``am`` was consumed this tick, so it is cleared
+    # before inserts — a max-delay send (D - 1 ticks extra) legally
+    # re-fills it for tick t + D. In-flight arrival ticks map to distinct
+    # slots, so a (slot, sender) overlap means two same-kind messages
+    # jittered onto one arrival tick — more than the per-sender payload
+    # lanes can hold: flagged sticky rather than silently merged.
+    dmat = monitor.delay_matrix(xp, faults, t)
+    darange = xp.arange(D, dtype=xp.int32)
+    keep = (darange != am)[:, None, None]
+    slot_hit = ((t + 1 + dmat) % D)[None, :, :] == darange[:, None, None]
+    coll = xp.zeros((), bool)
+
+    def ring_put(ring, fan):
+        cleared = ring & keep
+        ins = slot_hit & fan[None]
+        hit_old = (cleared.any(axis=-1) & ins.any(axis=-1)).any()
+        return cleared | ins, ins.any(axis=-1), hit_old
+
+    def stamp(old, new, landed):
+        mask = landed.reshape(landed.shape + (1,) * (old.ndim - landed.ndim))
+        return xp.where(mask, new[None], old)
+
     v.tick = t
-    v.wv, v.wv_fp_hi, v.wv_fp_lo = wv_new, wv_fp_hi_new, wv_fp_lo_new
-    v.wv_cfg_hi, v.wv_cfg_lo = wv_cfg_hi_new, wv_cfg_lo_new
-    v.wv_seq, v.wv_bcast = wv_seq_new, wv_bcast_new
-    v.w1a, v.w1a_seq, v.w1a_bcast = w1a_new, w1a_seq_new, w1a_bcast_new
-    v.w1a_cfg_hi, v.w1a_cfg_lo = w1a_cfg_hi_new, w1a_cfg_lo_new
-    v.w1b = w1b_new
-    v.w1b_vrnd_r, v.w1b_vrnd_i = w1b_vrnd_r_new, w1b_vrnd_i_new
-    v.w1b_fp_hi, v.w1b_fp_lo = w1b_fp_hi_new, w1b_fp_lo_new
-    v.w1b_set = w1b_set_new
-    v.w1b_cfg_hi, v.w1b_cfg_lo = w1b_cfg_hi_new, w1b_cfg_lo_new
-    v.w2a, v.w2a_mask = w2a_new, w2a_mask_new
-    v.w2a_fp_hi, v.w2a_fp_lo = w2a_fp_hi_new, w2a_fp_lo_new
-    v.w2a_cfg_hi, v.w2a_cfg_lo = w2a_cfg_hi_new, w2a_cfg_lo_new
-    v.w2a_seq, v.w2a_bcast = w2a_seq_new, w2a_bcast_new
-    v.w2b, v.w2b_rnd = w2b_new, w2b_rnd_new
-    v.w2b_fp_hi, v.w2b_fp_lo = w2b_fp_hi_new, w2b_fp_lo_new
-    v.w2b_mask = w2b_mask_new
-    v.w2b_cfg_hi, v.w2b_cfg_lo = w2b_cfg_hi_new, w2b_cfg_lo_new
-    v.w2b_bcast = w2b_bcast_new
-    v.pd, v.pd_dst = pd_new, pd_dst_new
-    v.pd_cfg_hi, v.pd_cfg_lo = pd_cfg_hi_new, pd_cfg_lo_new
-    v.pd_bcast = pd_bcast_new
+    v.wv, landed, hit_old = ring_put(rs.wv, wv_fan)
+    coll |= hit_old
+    v.wv_fp_hi = stamp(rs.wv_fp_hi, wv_fp_hi_new, landed)
+    v.wv_fp_lo = stamp(rs.wv_fp_lo, wv_fp_lo_new, landed)
+    v.wv_cfg_hi = stamp(rs.wv_cfg_hi, wv_cfg_hi_new, landed)
+    v.wv_cfg_lo = stamp(rs.wv_cfg_lo, wv_cfg_lo_new, landed)
+    v.wv_seq = stamp(rs.wv_seq, wv_seq_new, landed)
+
+    v.w1a, landed, hit_old = ring_put(rs.w1a, w1a_fan)
+    coll |= hit_old
+    v.w1a_cfg_hi = stamp(rs.w1a_cfg_hi, w1a_cfg_hi_new, landed)
+    v.w1a_cfg_lo = stamp(rs.w1a_cfg_lo, w1a_cfg_lo_new, landed)
+    v.w1a_seq = stamp(rs.w1a_seq, w1a_seq_new, landed)
+    v.w1a_tick = xp.where(landed, t, rs.w1a_tick)
+
+    v.w1b, landed, hit_old = ring_put(rs.w1b, w1b_new)
+    coll |= hit_old
+    v.w1b_vrnd_r = stamp(rs.w1b_vrnd_r, w1b_vrnd_r_new, landed)
+    v.w1b_vrnd_i = stamp(rs.w1b_vrnd_i, w1b_vrnd_i_new, landed)
+    v.w1b_fp_hi = stamp(rs.w1b_fp_hi, w1b_fp_hi_new, landed)
+    v.w1b_fp_lo = stamp(rs.w1b_fp_lo, w1b_fp_lo_new, landed)
+    v.w1b_set = stamp(rs.w1b_set, w1b_set_new, landed)
+    v.w1b_cfg_hi = stamp(rs.w1b_cfg_hi, w1b_cfg_hi_new, landed)
+    v.w1b_cfg_lo = stamp(rs.w1b_cfg_lo, w1b_cfg_lo_new, landed)
+    # Promiser send key, stamped post-rebuild: rx_pos here equals the
+    # value the delivery-tick prefix logic read off the state before.
+    v.w1b_seq = stamp(rs.w1b_seq, t * (c + 1) + v.rx_pos, landed)
+
+    v.w2a, landed, hit_old = ring_put(rs.w2a, w2a_fan)
+    coll |= hit_old
+    v.w2a_fp_hi = stamp(rs.w2a_fp_hi, w2a_fp_hi_new, landed)
+    v.w2a_fp_lo = stamp(rs.w2a_fp_lo, w2a_fp_lo_new, landed)
+    v.w2a_mask = stamp(rs.w2a_mask, w2a_mask_new, landed)
+    v.w2a_cfg_hi = stamp(rs.w2a_cfg_hi, w2a_cfg_hi_new, landed)
+    v.w2a_cfg_lo = stamp(rs.w2a_cfg_lo, w2a_cfg_lo_new, landed)
+    v.w2a_seq = stamp(rs.w2a_seq, w2a_seq_new, landed)
+    v.w2a_tick = xp.where(landed, t, rs.w2a_tick)
+
+    # 2b: the two payload lanes share one sender row (and cfg snapshot),
+    # so old/new overlap is checked per (slot, sender) across lanes.
+    cleared = rs.w2b & keep[:, None]
+    ins = slot_hit[:, None] & w2b_fan[None]
+    coll |= (cleared.any(axis=(1, 3)) & ins.any(axis=(1, 3))).any()
+    v.w2b = cleared | ins
+    lane_landed = ins.any(axis=-1)                       # [D, 2, C]
+    v.w2b_rnd = stamp(rs.w2b_rnd, w2b_rnd_new, lane_landed)
+    v.w2b_fp_hi = stamp(rs.w2b_fp_hi, w2b_fp_hi_new, lane_landed)
+    v.w2b_fp_lo = stamp(rs.w2b_fp_lo, w2b_fp_lo_new, lane_landed)
+    v.w2b_mask = stamp(rs.w2b_mask, w2b_mask_new, lane_landed)
+    sender_landed = lane_landed.any(axis=1)              # [D, C]
+    v.w2b_cfg_hi = stamp(rs.w2b_cfg_hi, w2b_cfg_hi_new, sender_landed)
+    v.w2b_cfg_lo = stamp(rs.w2b_cfg_lo, w2b_cfg_lo_new, sender_landed)
+
+    v.pd_bcast, landed, hit_old = ring_put(rs.pd_bcast, pd_fan)
+    coll |= hit_old
+    v.pd = stamp(rs.pd, pd_new, landed)
+    v.pd_dst = stamp(rs.pd_dst, pd_dst_new, landed)
+    v.pd_cfg_hi = stamp(rs.pd_cfg_hi, pd_cfg_hi_new, landed)
+    v.pd_cfg_lo = stamp(rs.pd_cfg_lo, pd_cfg_lo_new, landed)
+
+    v.flags = v.flags | xp.where(coll, FLAG_RING_COLLISION, 0)
 
     log = ReceiverStepLog(
         tick=t,
@@ -669,6 +826,7 @@ def init_receiver_state(uids: Sequence[int], id_fp_sum: int,
                          f"{settings.batching_window_ticks}")
     base = init_state(uids, id_fp_sum, settings, member=member)
     c, k = base.ring_order.shape
+    d = settings.delivery_ring_depth
     xp = jnp
     member_row = base.member
     member_cc = xp.broadcast_to(member_row[None, :], (c, c))
@@ -712,15 +870,15 @@ def init_receiver_state(uids: Sequence[int], id_fp_sum: int,
         fc=i32z(c, k), notified=bz(c, k), fd_gate=i32z(c),
         pf=bz(c, k), pf_dst=i32z(c, k),
         pf_cfg_hi=u32z(c), pf_cfg_lo=u32z(c),
-        pd=bz(c, k), pd_dst=i32z(c, k),
-        pd_cfg_hi=u32z(c), pd_cfg_lo=u32z(c), pd_bcast=bz(c, c),
+        pd=bz(d, c, k), pd_dst=i32z(d, c, k),
+        pd_cfg_hi=u32z(d, c), pd_cfg_lo=u32z(d, c), pd_bcast=bz(d, c, c),
         reports=bz(c, c, k), seen_down=bz(c), announced=bz(c),
         ar_seq=xp.full((c,), I32_MAX, xp.int32),
         reg_valid=bz(c), reg_mask=bz(c, c),
         reg_fp_hi=u32z(c), reg_fp_lo=u32z(c),
-        wv=bz(c), wv_fp_hi=u32z(c), wv_fp_lo=u32z(c),
-        wv_cfg_hi=u32z(c), wv_cfg_lo=u32z(c),
-        wv_seq=xp.full((c,), I32_MAX, xp.int32), wv_bcast=bz(c, c),
+        wv=bz(d, c, c), wv_fp_hi=u32z(d, c), wv_fp_lo=u32z(d, c),
+        wv_cfg_hi=u32z(d, c), wv_cfg_lo=u32z(d, c),
+        wv_seq=xp.full((d, c), I32_MAX, xp.int32),
         vt_seen=bz(c, c), vt_fp_hi=u32z(c, c), vt_fp_lo=u32z(c, c),
         px_rnd_r=i32z(c), px_rnd_i=i32z(c),
         px_vrnd_r=i32z(c), px_vrnd_i=i32z(c),
@@ -732,17 +890,19 @@ def init_receiver_state(uids: Sequence[int], id_fp_sum: int,
         pb_seq=i32z(c, c),
         p2_rnd=xp.full((c,), -1, xp.int32), p2_seen=bz(c, c),
         p2_mask=bz(c, c),
-        w1a=bz(c), w1a_cfg_hi=u32z(c), w1a_cfg_lo=u32z(c),
-        w1a_seq=xp.full((c,), I32_MAX, xp.int32), w1a_bcast=bz(c, c),
-        w1b=bz(c, c), w1b_vrnd_r=i32z(c), w1b_vrnd_i=i32z(c),
-        w1b_fp_hi=u32z(c), w1b_fp_lo=u32z(c), w1b_set=bz(c),
-        w1b_cfg_hi=u32z(c), w1b_cfg_lo=u32z(c),
-        w2a=bz(c), w2a_fp_hi=u32z(c), w2a_fp_lo=u32z(c),
-        w2a_mask=bz(c, c), w2a_cfg_hi=u32z(c), w2a_cfg_lo=u32z(c),
-        w2a_seq=xp.full((c,), I32_MAX, xp.int32), w2a_bcast=bz(c, c),
-        w2b=bz(2, c), w2b_rnd=i32z(2, c),
-        w2b_fp_hi=u32z(2, c), w2b_fp_lo=u32z(2, c), w2b_mask=bz(2, c, c),
-        w2b_cfg_hi=u32z(c), w2b_cfg_lo=u32z(c), w2b_bcast=bz(c, c),
+        w1a=bz(d, c, c), w1a_cfg_hi=u32z(d, c), w1a_cfg_lo=u32z(d, c),
+        w1a_seq=xp.full((d, c), I32_MAX, xp.int32), w1a_tick=i32z(d, c),
+        w1b=bz(d, c, c), w1b_vrnd_r=i32z(d, c), w1b_vrnd_i=i32z(d, c),
+        w1b_fp_hi=u32z(d, c), w1b_fp_lo=u32z(d, c), w1b_set=bz(d, c),
+        w1b_cfg_hi=u32z(d, c), w1b_cfg_lo=u32z(d, c),
+        w1b_seq=xp.full((d, c), I32_MAX, xp.int32),
+        w2a=bz(d, c, c), w2a_fp_hi=u32z(d, c), w2a_fp_lo=u32z(d, c),
+        w2a_mask=bz(d, c, c), w2a_cfg_hi=u32z(d, c), w2a_cfg_lo=u32z(d, c),
+        w2a_seq=xp.full((d, c), I32_MAX, xp.int32), w2a_tick=i32z(d, c),
+        w2b=bz(d, 2, c, c), w2b_rnd=i32z(d, 2, c),
+        w2b_fp_hi=u32z(d, 2, c), w2b_fp_lo=u32z(d, 2, c),
+        w2b_mask=bz(d, 2, c, c),
+        w2b_cfg_hi=u32z(d, c), w2b_cfg_lo=u32z(d, c),
         flags=xp.int32(0),
     )
 
@@ -875,11 +1035,13 @@ def receiver_run_payload(rs: ReceiverState, logs, n: int, n_ticks: int):
 
 # --- memory sizing -------------------------------------------------------
 
-def receiver_field_shapes(capacity: int, k: int, n_draws: int = N_DRAWS):
+def receiver_field_shapes(capacity: int, k: int, n_draws: int = N_DRAWS,
+                          ring_depth: int = 4):
     """``{field: (shape, itemsize)}`` for every ``ReceiverState`` leaf —
     the sizing ground truth (``tests/test_receiver.py`` pins each entry
-    against a real instantiation so the table cannot drift)."""
-    c = capacity
+    against a real instantiation so the table cannot drift). ``ring_depth``
+    must match ``Settings.delivery_ring_depth`` (default mirrors it)."""
+    c, d = capacity, ring_depth
     B, I, U = 1, 4, 4          # bool, int32, uint32 itemsizes
     s = {"tick": ((), I), "flags": ((), I),
          "idsum_hi": ((), U), "idsum_lo": ((), U),
@@ -889,31 +1051,37 @@ def receiver_field_shapes(capacity: int, k: int, n_draws: int = N_DRAWS):
          "own_subj": ((c, k), I), "own_fd_first": ((c, k), I),
          "own_fd_active": ((c, k), B), "fc": ((c, k), I),
          "notified": ((c, k), B), "pf": ((c, k), B),
-         "pf_dst": ((c, k), I), "pd": ((c, k), B), "pd_dst": ((c, k), I),
-         "w2b": ((2, c), B), "w2b_rnd": ((2, c), I),
-         "w2b_fp_hi": ((2, c), U), "w2b_fp_lo": ((2, c), U),
-         "w2b_mask": ((2, c, c), B)}
+         "pf_dst": ((c, k), I),
+         "pd": ((d, c, k), B), "pd_dst": ((d, c, k), I),
+         "w2b": ((d, 2, c, c), B), "w2b_rnd": ((d, 2, c), I),
+         "w2b_fp_hi": ((d, 2, c), U), "w2b_fp_lo": ((d, 2, c), U),
+         "w2b_mask": ((d, 2, c, c), B)}
     for f in ("uid_hi", "uid_lo", "mfp_hi", "mfp_lo", "memsum_hi",
               "memsum_lo", "cfg_hi", "cfg_lo", "pf_cfg_hi", "pf_cfg_lo",
-              "pd_cfg_hi", "pd_cfg_lo", "reg_fp_hi", "reg_fp_lo",
-              "wv_fp_hi", "wv_fp_lo", "wv_cfg_hi", "wv_cfg_lo",
-              "px_vv_fp_hi", "px_vv_fp_lo", "w1a_cfg_hi", "w1a_cfg_lo",
+              "reg_fp_hi", "reg_fp_lo", "px_vv_fp_hi", "px_vv_fp_lo"):
+        s[f] = ((c,), U)
+    for f in ("pd_cfg_hi", "pd_cfg_lo", "wv_fp_hi", "wv_fp_lo",
+              "wv_cfg_hi", "wv_cfg_lo", "w1a_cfg_hi", "w1a_cfg_lo",
               "w1b_fp_hi", "w1b_fp_lo", "w1b_cfg_hi", "w1b_cfg_lo",
               "w2a_fp_hi", "w2a_fp_lo", "w2a_cfg_hi", "w2a_cfg_lo",
               "w2b_cfg_hi", "w2b_cfg_lo"):
-        s[f] = ((c,), U)
+        s[f] = ((d, c), U)
     for f in ("rank_idx", "draws", "epoch", "rx_pos", "px_n", "fd_gate",
-              "ar_seq", "wv_seq", "px_rnd_r", "px_rnd_i", "px_vrnd_r",
-              "px_vrnd_i", "px_crnd_r", "px_timer", "p2_rnd", "w1a_seq",
-              "w1b_vrnd_r", "w1b_vrnd_i", "w2a_seq"):
+              "ar_seq", "px_rnd_r", "px_rnd_i", "px_vrnd_r",
+              "px_vrnd_i", "px_crnd_r", "px_timer", "p2_rnd"):
         s[f] = ((c,), I)
-    for f in ("stopped", "seen_down", "announced", "reg_valid", "wv",
-              "px_vv_set", "px_cval_set", "w1a", "w2a", "w1b_set"):
+    for f in ("wv_seq", "w1a_seq", "w1a_tick", "w1b_vrnd_r", "w1b_vrnd_i",
+              "w1b_seq", "w2a_seq", "w2a_tick"):
+        s[f] = ((d, c), I)
+    for f in ("stopped", "seen_down", "announced", "reg_valid",
+              "px_vv_set", "px_cval_set"):
         s[f] = ((c,), B)
-    for f in ("member", "pd_bcast", "reg_mask", "wv_bcast", "vt_seen",
-              "pb_seen", "pb_set", "p2_seen", "p2_mask", "w1a_bcast",
-              "w1b", "w2a_mask", "w2a_bcast", "w2b_bcast"):
+    s["w1b_set"] = ((d, c), B)
+    for f in ("member", "reg_mask", "vt_seen",
+              "pb_seen", "pb_set", "p2_seen", "p2_mask"):
         s[f] = ((c, c), B)
+    for f in ("wv", "w1a", "w1b", "w2a", "w2a_mask", "pd_bcast"):
+        s[f] = ((d, c, c), B)
     for f in ("vt_fp_hi", "vt_fp_lo", "pb_fp_hi", "pb_fp_lo"):
         s[f] = ((c, c), U)
     for f in ("pb_vrnd_r", "pb_vrnd_i", "pb_seq"):
@@ -924,11 +1092,13 @@ def receiver_field_shapes(capacity: int, k: int, n_draws: int = N_DRAWS):
 
 
 def receiver_state_bytes(capacity: int, k: int,
-                         n_draws: int = N_DRAWS) -> int:
+                         n_draws: int = N_DRAWS,
+                         ring_depth: int = 4) -> int:
     """Exact per-member footprint of one ``ReceiverState`` in bytes."""
     return sum(int(np.prod(shape, dtype=np.int64)) * item
                for shape, item in
-               receiver_field_shapes(capacity, k, n_draws).values())
+               receiver_field_shapes(capacity, k, n_draws,
+                                     ring_depth).values())
 
 
 def receiver_log_bytes(capacity: int, n_ticks: int) -> int:

@@ -81,6 +81,15 @@ class EngineFaults:
     the reverse direction. ``W = 0`` (the default) compiles the link logic
     out entirely — the step branches on the static leading dimension.
 
+    The ``delay_*`` arrays rule-encode ``faults.DelayRule`` per-edge link
+    latencies for the per-receiver delivery ring: rule ``r`` holds a
+    src->dst slot-set pair, a base delay (``delay_base``), a jitter bound
+    (``delay_jit``, drawn per (edge, send tick) via the shared hash with
+    seed limbs ``delay_seed_hi/lo``), an optional reverse-direction base
+    (``delay_rev``, -1 = none), and an active tick range. ``R = 0``
+    compiles the delay logic out (``monitor.delay_matrix`` returns a
+    constant-zero matrix the compiler folds away).
+
     Registered as a pytree with the drop *configuration* as static aux data:
     the step function branches on ``drop_p`` in Python, so it must not be a
     traced leaf — changing it retriggers a (cheap, rare) retrace instead.
@@ -90,7 +99,10 @@ class EngineFaults:
                  drop_targets=None, drop_ingress: bool = True,
                  drop_egress: bool = True, link_src=None, link_dst=None,
                  link_start=None, link_end=None, link_period=None,
-                 link_two_way=None) -> None:
+                 link_two_way=None, delay_src=None, delay_dst=None,
+                 delay_base=None, delay_rev=None, delay_jit=None,
+                 delay_start=None, delay_end=None, delay_seed_hi=None,
+                 delay_seed_lo=None) -> None:
         self.crash_tick = crash_tick  # i32 [C]
         self.drop_p = float(drop_p)
         self.drop_seed = int(drop_seed)
@@ -103,15 +115,31 @@ class EngineFaults:
         self.link_end = link_end          # i32 [W]
         self.link_period = link_period    # i32 [W] (0 = static window)
         self.link_two_way = link_two_way  # bool [W]
+        self.delay_src = delay_src        # bool [R, C] or None (R = 0)
+        self.delay_dst = delay_dst        # bool [R, C]
+        self.delay_base = delay_base      # i32 [R]
+        self.delay_rev = delay_rev        # i32 [R] (-1 = no reverse delay)
+        self.delay_jit = delay_jit        # i32 [R] jitter bound (inclusive)
+        self.delay_start = delay_start    # i32 [R]
+        self.delay_end = delay_end        # i32 [R]
+        self.delay_seed_hi = delay_seed_hi  # u32 scalar jitter-hash seed
+        self.delay_seed_lo = delay_seed_lo  # u32 scalar
 
     @property
     def n_windows(self) -> int:
         return 0 if self.link_src is None else int(self.link_src.shape[0])
 
+    @property
+    def n_delay_rules(self) -> int:
+        return 0 if self.delay_src is None else int(self.delay_src.shape[0])
+
     def tree_flatten(self):
         children = (self.crash_tick, self.drop_targets, self.link_src,
                     self.link_dst, self.link_start, self.link_end,
-                    self.link_period, self.link_two_way)
+                    self.link_period, self.link_two_way, self.delay_src,
+                    self.delay_dst, self.delay_base, self.delay_rev,
+                    self.delay_jit, self.delay_start, self.delay_end,
+                    self.delay_seed_hi, self.delay_seed_lo)
         aux = (self.drop_p, self.drop_seed, self.drop_targets is None,
                self.drop_ingress, self.drop_egress)
         return children, aux
@@ -119,12 +147,16 @@ class EngineFaults:
     @classmethod
     def tree_unflatten(cls, aux, children):
         (crash_tick, drop_targets, link_src, link_dst, link_start,
-         link_end, link_period, link_two_way) = children
+         link_end, link_period, link_two_way, delay_src, delay_dst,
+         delay_base, delay_rev, delay_jit, delay_start, delay_end,
+         delay_seed_hi, delay_seed_lo) = children
         drop_p, drop_seed, targets_none, ingress, egress = aux
         return cls(crash_tick, drop_p, drop_seed,
                    None if targets_none else drop_targets, ingress, egress,
                    link_src, link_dst, link_start, link_end, link_period,
-                   link_two_way)
+                   link_two_way, delay_src, delay_dst, delay_base,
+                   delay_rev, delay_jit, delay_start, delay_end,
+                   delay_seed_hi, delay_seed_lo)
 
 
 def _register_faults() -> None:
@@ -289,18 +321,27 @@ class ReceiverState(NamedTuple):
     link faults (see the module docstring). ``ReceiverState`` replicates
     the view-dependent state per receiver: ``member``/``reports``/topology
     become ``[C, C(, K)]`` with axis 0 the *receiver* slot, and the wire
-    is explicit (one in-flight buffer per message kind with the sender's
-    cfg/bcast snapshot), so ``LinkWindow`` reachability is evaluated at
-    delivery per (sender, receiver) edge — bit-exact against
-    ``engine.adversary`` for link-fault scenarios. Memory is quadratic by
-    design; ``engine.receiver.receiver_state_bytes`` sizes it and
+    is explicit (one bounded in-flight *delivery ring* per message kind),
+    so ``LinkWindow`` reachability is evaluated at delivery per (sender,
+    receiver) edge — bit-exact against ``engine.adversary`` for link-fault
+    and link-delay scenarios. Memory is quadratic by design;
+    ``engine.receiver.receiver_state_bytes`` sizes it and
     ``Settings.receiver_capacity_cap`` bounds it.
 
+    Wire layout: every wire tensor carries a leading ``[D]`` axis
+    (``D = Settings.delivery_ring_depth``) indexed by arrival tick mod D —
+    a message sent at tick ``t`` on an edge with delay ``d`` lands in ring
+    slot ``(t + 1 + d) % D`` and is read back when the engine reaches that
+    tick. The per-sender broadcast fan (formerly separate ``*_bcast``
+    snapshots) is resolved at send time into the ``[D, C, C]`` presence
+    rings, since per-edge delays split one broadcast across ring slots.
+    ``D = 1`` with no delay rules is exactly the old next-tick wire.
+
     Naming: ``rx_*``/``own_*`` are per-receiver-diagonal quantities (the
-    slot's own row in its own view), ``w*`` are wire buffers (sent last
-    tick, delivered next), ``pf``/``pd`` the alert batcher pipeline
-    (pending-flush / in-flight), ``pb``/``p2`` the phase-1b / phase-2b
-    stores of a slot acting as coordinator / listener.
+    slot's own row in its own view), ``w*`` are wire rings (stamped at
+    send, delivered at their arrival slot), ``pf``/``pd`` the alert
+    batcher pipeline (pending-flush / in-flight ring), ``pb``/``p2`` the
+    phase-1b / phase-2b stores of a slot acting as coordinator / listener.
     """
 
     tick: object            # i32
@@ -340,11 +381,11 @@ class ReceiverState(NamedTuple):
     pf_dst: object          # i32 [C, K]
     pf_cfg_hi: object       # u32 [C] cfg stamp at enqueue
     pf_cfg_lo: object       # u32 [C]
-    pd: object              # bool [C, K]: batch in flight (deliver next)
-    pd_dst: object          # i32 [C, K]
-    pd_cfg_hi: object       # u32 [C]
-    pd_cfg_lo: object       # u32 [C]
-    pd_bcast: object        # bool [C, C] recipient snapshot at flush
+    pd: object              # bool [D, C, K]: batch in-flight delivery ring
+    pd_dst: object          # i32 [D, C, K]
+    pd_cfg_hi: object       # u32 [D, C]
+    pd_cfg_lo: object       # u32 [D, C]
+    pd_bcast: object        # bool [D, C, C] recipient snapshot at flush
     # --- cut detector ------------------------------------------------
     reports: object         # bool [C, C, K] (receiver, dst, ring)
     seen_down: object       # bool [C]
@@ -356,13 +397,12 @@ class ReceiverState(NamedTuple):
     reg_fp_hi: object       # u32 [C]
     reg_fp_lo: object       # u32 [C]
     # --- fast-round votes --------------------------------------------
-    wv: object              # bool [C] vote wire (sender-indexed)
-    wv_fp_hi: object        # u32 [C]
-    wv_fp_lo: object        # u32 [C]
-    wv_cfg_hi: object       # u32 [C]
-    wv_cfg_lo: object       # u32 [C]
-    wv_seq: object          # i32 [C] sender announce-order key
-    wv_bcast: object        # bool [C, C]
+    wv: object              # bool [D, C, C] vote ring (sender, receiver)
+    wv_fp_hi: object        # u32 [D, C]
+    wv_fp_lo: object        # u32 [D, C]
+    wv_cfg_hi: object       # u32 [D, C]
+    wv_cfg_lo: object       # u32 [D, C]
+    wv_seq: object          # i32 [D, C] sender announce-order key
     vt_seen: object         # bool [C, C] (receiver, voter)
     vt_fp_hi: object        # u32 [C, C]
     vt_fp_lo: object        # u32 [C, C]
@@ -384,44 +424,44 @@ class ReceiverState(NamedTuple):
     pb_fp_hi: object        # u32 [C, C]
     pb_fp_lo: object        # u32 [C, C]
     pb_set: object          # bool [C, C] vval non-empty
-    pb_seq: object          # i32 [C, C] arrival key t*(C+1)+rx_pos(promiser)
+    pb_seq: object          # i32 [C, C] send key t*(C+1)+rx_pos(promiser)
     # --- phase-2b store (listener, acceptor), single tracked round ---
     p2_rnd: object          # i32 [C] rank index of tracked round, -1 none
     p2_seen: object         # bool [C, C]
     p2_mask: object         # bool [C, C] decide contents (member mask)
     # --- wires: phase 1a ---------------------------------------------
-    w1a: object             # bool [C] (coordinator-indexed)
-    w1a_cfg_hi: object      # u32 [C]
-    w1a_cfg_lo: object      # u32 [C]
-    w1a_seq: object         # i32 [C]
-    w1a_bcast: object       # bool [C, C]
+    w1a: object             # bool [D, C, C] (coordinator, receiver)
+    w1a_cfg_hi: object      # u32 [D, C]
+    w1a_cfg_lo: object      # u32 [D, C]
+    w1a_seq: object         # i32 [D, C] announce key (within-tick order)
+    w1a_tick: object        # i32 [D, C] send tick (cross-tick order)
     # --- wires: phase 1b (promiser, coordinator) ---------------------
-    w1b: object             # bool [C, C]
-    w1b_vrnd_r: object      # i32 [C] payload per promiser
-    w1b_vrnd_i: object      # i32 [C]
-    w1b_fp_hi: object       # u32 [C]
-    w1b_fp_lo: object       # u32 [C]
-    w1b_set: object         # bool [C]
-    w1b_cfg_hi: object      # u32 [C]
-    w1b_cfg_lo: object      # u32 [C]
+    w1b: object             # bool [D, C, C]
+    w1b_vrnd_r: object      # i32 [D, C] payload per promiser
+    w1b_vrnd_i: object      # i32 [D, C]
+    w1b_fp_hi: object       # u32 [D, C]
+    w1b_fp_lo: object       # u32 [D, C]
+    w1b_set: object         # bool [D, C]
+    w1b_cfg_hi: object      # u32 [D, C]
+    w1b_cfg_lo: object      # u32 [D, C]
+    w1b_seq: object         # i32 [D, C] send key t*(C+1)+rx_pos(promiser)
     # --- wires: phase 2a ---------------------------------------------
-    w2a: object             # bool [C] (coordinator-indexed)
-    w2a_fp_hi: object       # u32 [C]
-    w2a_fp_lo: object       # u32 [C]
-    w2a_mask: object        # bool [C, C] resolved proposal on the wire
-    w2a_cfg_hi: object      # u32 [C]
-    w2a_cfg_lo: object      # u32 [C]
-    w2a_seq: object         # i32 [C]
-    w2a_bcast: object       # bool [C, C]
+    w2a: object             # bool [D, C, C] (coordinator, receiver)
+    w2a_fp_hi: object       # u32 [D, C]
+    w2a_fp_lo: object       # u32 [D, C]
+    w2a_mask: object        # bool [D, C, C] resolved proposal on the wire
+    w2a_cfg_hi: object      # u32 [D, C]
+    w2a_cfg_lo: object      # u32 [D, C]
+    w2a_seq: object         # i32 [D, C] announce key (within-tick order)
+    w2a_tick: object        # i32 [D, C] send tick (cross-tick order)
     # --- wires: phase 2b, up to 2 accepts per acceptor per tick ------
-    w2b: object             # bool [2, C] (slot, acceptor)
-    w2b_rnd: object         # i32 [2, C] rank index of accepted round
-    w2b_fp_hi: object       # u32 [2, C]
-    w2b_fp_lo: object       # u32 [2, C]
-    w2b_mask: object        # bool [2, C, C]
-    w2b_cfg_hi: object      # u32 [C] one snapshot per acceptor
-    w2b_cfg_lo: object      # u32 [C]
-    w2b_bcast: object       # bool [C, C]
+    w2b: object             # bool [D, 2, C, C] (slot, acceptor, receiver)
+    w2b_rnd: object         # i32 [D, 2, C] rank index of accepted round
+    w2b_fp_hi: object       # u32 [D, 2, C]
+    w2b_fp_lo: object       # u32 [D, 2, C]
+    w2b_mask: object        # bool [D, 2, C, C]
+    w2b_cfg_hi: object      # u32 [D, C] one snapshot per acceptor
+    w2b_cfg_lo: object      # u32 [D, C]
     # --- envelope / error flags (sticky bitmask, see receiver.FLAGS) --
     flags: object           # i32 scalar
 
@@ -598,38 +638,68 @@ def crash_faults(crash_ticks: Sequence[int]) -> EngineFaults:
 
 
 def link_faults(crash_ticks: Sequence[int], windows,
-                capacity: int) -> EngineFaults:
-    """EngineFaults for crashes plus ``faults.LinkWindow`` link masks.
+                capacity: int, delays=(), delay_seed: int = 0) -> EngineFaults:
+    """EngineFaults for crashes plus ``faults.LinkWindow`` link masks plus
+    ``faults.DelayRule`` per-edge latencies.
 
-    ``windows`` is a sequence of slot-indexed ``LinkWindow``s; an empty
-    sequence degenerates to ``crash_faults`` (W = 0, link logic compiled
-    out).
+    ``windows``/``delays`` are sequences of slot-indexed rules; empty
+    sequences degenerate to ``crash_faults`` (W = 0 / R = 0, the link and
+    delay logic compiled out). ``delay_seed`` is the schedule seed feeding
+    the shared per-(edge, tick) jitter hash.
     """
     import jax.numpy as jnp
 
     base = crash_faults(crash_ticks)
     windows = tuple(windows)
-    if not windows:
+    delays = tuple(delays)
+    kw = {}
+    if windows:
+        w = len(windows)
+        src = np.zeros((w, capacity), bool)
+        dst = np.zeros((w, capacity), bool)
+        start = np.zeros(w, np.int32)
+        end = np.zeros(w, np.int32)
+        period = np.zeros(w, np.int32)
+        two_way = np.zeros(w, bool)
+        for i, win in enumerate(windows):
+            src[i, list(win.src_slots)] = True
+            dst[i, list(win.dst_slots)] = True
+            start[i] = win.start_tick
+            end[i] = min(win.end_tick, I32_MAX)
+            period[i] = win.period_ticks
+            two_way[i] = win.two_way
+        kw.update(
+            link_src=jnp.asarray(src), link_dst=jnp.asarray(dst),
+            link_start=jnp.asarray(start), link_end=jnp.asarray(end),
+            link_period=jnp.asarray(period),
+            link_two_way=jnp.asarray(two_way))
+    if delays:
+        r = len(delays)
+        dsrc = np.zeros((r, capacity), bool)
+        ddst = np.zeros((r, capacity), bool)
+        dbase = np.zeros(r, np.int32)
+        drev = np.zeros(r, np.int32)
+        djit = np.zeros(r, np.int32)
+        dstart = np.zeros(r, np.int32)
+        dend = np.zeros(r, np.int32)
+        for i, rule in enumerate(delays):
+            dsrc[i, list(rule.src_slots)] = True
+            ddst[i, list(rule.dst_slots)] = True
+            dbase[i] = rule.delay_ticks
+            drev[i] = rule.reverse_delay_ticks
+            djit[i] = rule.jitter_ticks
+            dstart[i] = rule.start_tick
+            dend[i] = min(rule.end_tick, I32_MAX)
+        shi, slo = hashing.to_limbs((delay_seed ^ 0x6A1770) & hashing.MASK64)
+        kw.update(
+            delay_src=jnp.asarray(dsrc), delay_dst=jnp.asarray(ddst),
+            delay_base=jnp.asarray(dbase), delay_rev=jnp.asarray(drev),
+            delay_jit=jnp.asarray(djit), delay_start=jnp.asarray(dstart),
+            delay_end=jnp.asarray(dend),
+            delay_seed_hi=jnp.uint32(shi), delay_seed_lo=jnp.uint32(slo))
+    if not kw:
         return base
-    w = len(windows)
-    src = np.zeros((w, capacity), bool)
-    dst = np.zeros((w, capacity), bool)
-    start = np.zeros(w, np.int32)
-    end = np.zeros(w, np.int32)
-    period = np.zeros(w, np.int32)
-    two_way = np.zeros(w, bool)
-    for i, win in enumerate(windows):
-        src[i, list(win.src_slots)] = True
-        dst[i, list(win.dst_slots)] = True
-        start[i] = win.start_tick
-        end[i] = min(win.end_tick, I32_MAX)
-        period[i] = win.period_ticks
-        two_way[i] = win.two_way
-    return EngineFaults(
-        crash_tick=base.crash_tick,
-        link_src=jnp.asarray(src), link_dst=jnp.asarray(dst),
-        link_start=jnp.asarray(start), link_end=jnp.asarray(end),
-        link_period=jnp.asarray(period), link_two_way=jnp.asarray(two_way))
+    return EngineFaults(crash_tick=base.crash_tick, **kw)
 
 
 def pad_link_windows(faults: EngineFaults, w: int) -> EngineFaults:
@@ -669,4 +739,60 @@ def pad_link_windows(faults: EngineFaults, w: int) -> EngineFaults:
         link_start=grow(faults.link_start, jnp.int32, ()),
         link_end=grow(faults.link_end, jnp.int32, ()),
         link_period=grow(faults.link_period, jnp.int32, ()),
-        link_two_way=grow(faults.link_two_way, bool, ()))
+        link_two_way=grow(faults.link_two_way, bool, ()),
+        delay_src=faults.delay_src, delay_dst=faults.delay_dst,
+        delay_base=faults.delay_base, delay_rev=faults.delay_rev,
+        delay_jit=faults.delay_jit, delay_start=faults.delay_start,
+        delay_end=faults.delay_end,
+        delay_seed_hi=faults.delay_seed_hi,
+        delay_seed_lo=faults.delay_seed_lo)
+
+
+def pad_delay_rules(faults: EngineFaults, r: int) -> EngineFaults:
+    """Pad the delay-rule tensors to exactly ``r`` rows with inert rules.
+
+    An inert rule has empty slot sets, zero base/jitter, no reverse
+    direction, and ``start == end == 0``, so every edge falls through to
+    the zero-delay default and the jitter hash is drawn mod 1 — provably
+    zero regardless of seed (``tests/test_delay.py`` pins this
+    bit-identically). Members with *no* delay rules get their seed limbs
+    materialized as zeros so all stacked members share one treedef.
+    ``r == n_delay_rules`` on a member that already has rules is a no-op;
+    shrinking is an error.
+    """
+    import jax.numpy as jnp
+
+    cur = faults.n_delay_rules
+    if r == cur:
+        # r == 0: the whole stack is delay-free, None leaves match.
+        # r > 0: link_faults materialized the seed limbs already.
+        return faults
+    if r < cur:
+        raise ValueError(f"cannot shrink {cur} delay rules to {r}")
+    c = int(faults.crash_tick.shape[0])
+    pad = r - cur
+
+    def grow(existing, fill_dtype, row_shape, fill=0):
+        tail = jnp.full((pad,) + row_shape, fill, fill_dtype)
+        if existing is None:
+            return tail
+        return jnp.concatenate([existing, tail], axis=0)
+
+    u32 = lambda v: jnp.uint32(0) if v is None else v
+    return EngineFaults(
+        crash_tick=faults.crash_tick,
+        drop_p=faults.drop_p, drop_seed=faults.drop_seed,
+        drop_targets=faults.drop_targets,
+        drop_ingress=faults.drop_ingress, drop_egress=faults.drop_egress,
+        link_src=faults.link_src, link_dst=faults.link_dst,
+        link_start=faults.link_start, link_end=faults.link_end,
+        link_period=faults.link_period, link_two_way=faults.link_two_way,
+        delay_src=grow(faults.delay_src, bool, (c,)),
+        delay_dst=grow(faults.delay_dst, bool, (c,)),
+        delay_base=grow(faults.delay_base, jnp.int32, ()),
+        delay_rev=grow(faults.delay_rev, jnp.int32, (), fill=-1),
+        delay_jit=grow(faults.delay_jit, jnp.int32, ()),
+        delay_start=grow(faults.delay_start, jnp.int32, ()),
+        delay_end=grow(faults.delay_end, jnp.int32, ()),
+        delay_seed_hi=u32(faults.delay_seed_hi),
+        delay_seed_lo=u32(faults.delay_seed_lo))

@@ -42,6 +42,13 @@ class FaultModel:
         """Whether a message sent src -> dst delivered at ``tick`` survives."""
         return True
 
+    def delay_of(self, src: Endpoint, dst: Endpoint, tick: int) -> int:
+        """Extra delivery delay in ticks for a message *sent* src -> dst at
+        ``tick`` (on top of the one-hop baseline). Unlike ``edge_ok``, this
+        is evaluated at the send tick: the latency of a link is a property
+        of when the message entered it."""
+        return 0
+
     # -- engine-facing: materialize masks for a slot universe ----------------
 
     def crash_mask(self, endpoints: Sequence[Endpoint], tick: int) -> np.ndarray:
@@ -201,6 +208,9 @@ class ComposedFault(FaultModel):
     def edge_ok(self, src, dst, tick):
         return all(m.edge_ok(src, dst, tick) for m in self.models)
 
+    def delay_of(self, src, dst, tick):
+        return sum(m.delay_of(src, dst, tick) for m in self.models)
+
     def crash_mask(self, endpoints, tick):
         mask = np.zeros(len(endpoints), dtype=bool)
         for m in self.models:
@@ -274,6 +284,82 @@ class LinkWindow:
 
 
 @dataclass(frozen=True)
+class DelayRule:
+    """One directed per-edge latency rule in slot coordinates.
+
+    A message *sent* at tick ``t`` (``start_tick <= t < end_tick``) from a
+    slot in ``src_slots`` to a slot in ``dst_slots`` is delivered
+    ``delay_ticks`` ticks later than the one-hop baseline, plus a bounded
+    jitter term drawn uniformly from ``[0, jitter_ticks]`` by the shared
+    seeded hash (``_delay_jitter`` — host and device sample bit-identical
+    values without sharing RNG state). ``reverse_delay_ticks >= 0`` also
+    delays the reverse direction by that base (slow-link asymmetry: a
+    different base per direction, same jitter bound); ``-1`` leaves the
+    reverse direction at the baseline. Unlike ``LinkWindow``, delay rules
+    are evaluated at the *send* tick — latency is a property of when the
+    message entered the link — while crash/window drops still apply at
+    the delivery tick. Jittered delays on one edge reorder messages:
+    receivers process them in announce order, exactly like the oracle.
+    """
+
+    src_slots: FrozenSet[int] = frozenset()
+    dst_slots: FrozenSet[int] = frozenset()
+    delay_ticks: int = 1
+    jitter_ticks: int = 0
+    reverse_delay_ticks: int = -1
+    start_tick: int = 0
+    end_tick: int = _NEVER_TICK
+
+    def active(self, tick: int) -> bool:
+        return self.start_tick <= tick < self.end_tick
+
+    def max_delay(self) -> int:
+        """Worst-case extra delay any edge of this rule can draw."""
+        return max(self.delay_ticks,
+                   max(self.reverse_delay_ticks, 0)) + self.jitter_ticks
+
+
+def _delay_jitter(seed: int, src_slot: int, dst_slot: int, tick: int,
+                  bound: int) -> int:
+    """Uniform draw from ``[0, bound]`` for edge (src, dst) at the send
+    tick. Pure function of (seed, slots, tick) — the device twin
+    (``engine.monitor.delay_matrix``) computes the identical hash on
+    uint32 limb pairs."""
+    if bound <= 0:
+        return 0
+    h = hashing.hash64(
+        src_slot ^ hashing.hash64(dst_slot, seed=tick & hashing.MASK64),
+        seed=(seed ^ 0x6A1770) & hashing.MASK64,
+    )
+    return int((h >> 32) % (bound + 1))
+
+
+def delay_of_slots(delays: Sequence[DelayRule], seed: int, src_slot: int,
+                   dst_slot: int, tick: int) -> int:
+    """Extra delivery delay for a message sent ``src -> dst`` at ``tick``.
+
+    Per rule, the forward direction is checked before the implied reverse
+    one; across rules the maximum applies (``validate_schedule`` rejects
+    overlapping coverage, so at most one rule matches a given edge, but
+    both referees share this exact combining order regardless).
+    """
+    best = 0
+    for r in delays:
+        if not r.active(tick):
+            continue
+        if src_slot in r.src_slots and dst_slot in r.dst_slots:
+            base = r.delay_ticks
+        elif r.reverse_delay_ticks >= 0 and src_slot in r.dst_slots \
+                and dst_slot in r.src_slots:
+            base = r.reverse_delay_ticks
+        else:
+            continue
+        best = max(best, base + _delay_jitter(seed, src_slot, dst_slot,
+                                              tick, r.jitter_ticks))
+    return best
+
+
+@dataclass(frozen=True)
 class ScriptedPropose:
     """One scripted consensus propose: slot ``slot`` proposes the removal
     of ``proposal`` (ascending slot tuple) at scheduler tick ``tick`` with
@@ -292,8 +378,10 @@ class AdversarySchedule:
     ``crashes`` maps slot -> fail-stop tick; ``windows`` are directed link
     windows; ``proposes`` are scripted consensus proposes (mid-fast-count
     fires, tied timers and rank races arise from these plus the organic
-    jittered timers — nothing here is pre-screened). ``seed`` feeds the
-    per-node jitter rng on both sides of the differential.
+    jittered timers — nothing here is pre-screened); ``delays`` are
+    per-edge latency rules (send-tick base + seeded jitter, see
+    ``DelayRule``). ``seed`` feeds the per-node jitter rng on both sides
+    of the differential and the per-edge delay-jitter hash.
     """
 
     n: int
@@ -301,6 +389,7 @@ class AdversarySchedule:
     windows: Tuple[LinkWindow, ...] = ()
     proposes: Tuple[ScriptedPropose, ...] = ()
     seed: int = 0
+    delays: Tuple[DelayRule, ...] = ()
 
     def crash_tick_array(self) -> np.ndarray:
         ticks = np.full(self.n, _NEVER_TICK, dtype=np.int64)
@@ -312,7 +401,10 @@ class AdversarySchedule:
         """The oracle-side ``FaultModel`` equivalent of this schedule."""
         crash = CrashFault({endpoints[slot]: tick
                             for slot, tick in self.crashes})
-        return ComposedFault([crash, LinkWindowFault(self.windows)])
+        models: List[FaultModel] = [crash, LinkWindowFault(self.windows)]
+        if self.delays:
+            models.append(LinkDelayFault(self.delays, self.seed))
+        return ComposedFault(models)
 
 
 class LinkWindowFault(FaultModel):
@@ -349,6 +441,49 @@ class LinkWindowFault(FaultModel):
                 blocked |= d[:, None] & s[None, :]
             mask &= ~blocked
         return mask
+
+
+class LinkDelayFault(FaultModel):
+    """Oracle-side latency rule for a tuple of slot-indexed ``DelayRule``s.
+
+    Only ``delay_of`` is overridden — delay rules never drop anything, so
+    ``edge_ok``/``edge_mask`` stay on the healthy fast path. Slot
+    resolution follows the ``nX.sim`` convention of
+    ``engine.diff.default_endpoints``, like ``LinkWindowFault``.
+    """
+
+    def __init__(self, delays: Sequence[DelayRule], seed: int) -> None:
+        self.delays = tuple(delays)
+        self.seed = seed
+
+    _slot = staticmethod(LinkWindowFault._slot)
+
+    def delay_of(self, src: Endpoint, dst: Endpoint, tick: int) -> int:
+        return delay_of_slots(self.delays, self.seed, self._slot(src),
+                              self._slot(dst), tick)
+
+
+class DelayBudgetError(ValueError):
+    """A delay rule's worst case does not fit the delivery ring.
+
+    The device lowers delays to a bounded in-flight ring of
+    ``Settings.delivery_ring_depth`` slots indexed by arrival tick, so the
+    largest representable extra delay is ``ring_depth - 1``. Structured
+    like ``fleet.ReceiverBudgetError``: refuse up front with the measured
+    numbers instead of silently wrapping the ring mid-run.
+    """
+
+    def __init__(self, ring_depth: int, max_delay: int, base_ticks: int,
+                 jitter_ticks: int) -> None:
+        self.ring_depth = ring_depth
+        self.max_delay = max_delay
+        self.base_ticks = base_ticks
+        self.jitter_ticks = jitter_ticks
+        super().__init__(
+            f"delay rule can draw up to {max_delay} extra ticks (base "
+            f"{base_ticks} + jitter {jitter_ticks}) but the delivery ring "
+            f"holds at most {ring_depth - 1} (depth {ring_depth}); raise "
+            f"Settings.delivery_ring_depth or shrink the rule")
 
 
 def link_windows_of(model: FaultModel,
@@ -389,14 +524,20 @@ def link_windows_of(model: FaultModel,
     return None
 
 
-def validate_schedule(schedule: AdversarySchedule) -> None:
+def validate_schedule(schedule: AdversarySchedule,
+                      ring_depth: Optional[int] = None) -> None:
     """Genuine input validation only — nothing scenario-shaped is rejected.
 
     Slots must exist, crashes and proposes must land at tick >= 1 (tick 0
     is the boot snapshot), proposals must be non-empty ascending slot
     tuples, explicit delays non-negative, and at most one scripted propose
     per slot (the device schedule carries one scripted timer slot per node
-    next to the organic one).
+    next to the organic one). Delay rules must have sane fields and
+    non-overlapping directed-edge coverage (including each rule's implied
+    reverse direction). When ``ring_depth`` is given — receiver-mode
+    lowering passes ``Settings.delivery_ring_depth`` — any rule whose
+    worst-case draw (base + jitter bound) exceeds ``ring_depth - 1``
+    raises ``DelayBudgetError`` instead of silently wrapping the ring.
     """
     n = schedule.n
     for slot, tick in schedule.crashes:
@@ -461,6 +602,53 @@ def validate_schedule(schedule: AdversarySchedule) -> None:
         if per_slot[p.slot] > 1:
             raise ValueError(f"more than one scripted propose on slot "
                              f"{p.slot} (device schedule capacity)")
+    for r in schedule.delays:
+        if not r.src_slots or not r.dst_slots:
+            raise ValueError("delay src_slots/dst_slots must be non-empty")
+        for s in r.src_slots | r.dst_slots:
+            if not 0 <= s < n:
+                raise ValueError(f"delay slot {s} outside universe of {n}")
+        if r.delay_ticks < 0:
+            raise ValueError("delay_ticks must be >= 0")
+        if r.jitter_ticks < 0:
+            raise ValueError("jitter_ticks must be >= 0")
+        if r.reverse_delay_ticks < -1:
+            raise ValueError("reverse_delay_ticks must be >= -1 "
+                             "(-1 means no reverse delay)")
+        if r.start_tick >= r.end_tick:
+            raise ValueError(
+                f"zero-length delay rule: start_tick {r.start_tick} >= "
+                f"end_tick {r.end_tick}")
+        if ring_depth is not None and r.max_delay() > ring_depth - 1:
+            raise DelayBudgetError(
+                ring_depth=ring_depth, max_delay=r.max_delay(),
+                base_ticks=max(r.delay_ticks, r.reverse_delay_ticks),
+                jitter_ticks=r.jitter_ticks)
+    # Two delay rules may not cover the same directed edge in overlapping
+    # tick ranges (counting each rule's implied reverse direction): the
+    # referees take the max, so the overlap would silently mask the
+    # smaller rule — reject so schedules stay composable-by-inspection.
+    delay_rules = list(schedule.delays)
+    for i, a in enumerate(delay_rules):
+        for b in delay_rules[i + 1:]:
+            if a.start_tick >= b.end_tick or b.start_tick >= a.end_tick:
+                continue
+            a_dirs = [(a.src_slots, a.dst_slots)] + (
+                [(a.dst_slots, a.src_slots)]
+                if a.reverse_delay_ticks >= 0 else [])
+            b_dirs = [(b.src_slots, b.dst_slots)] + (
+                [(b.dst_slots, b.src_slots)]
+                if b.reverse_delay_ticks >= 0 else [])
+            for asrc, adst in a_dirs:
+                for bsrc, bdst in b_dirs:
+                    if (asrc & bsrc) and (adst & bdst):
+                        s = min(asrc & bsrc)
+                        d = min(adst & bdst)
+                        raise ValueError(
+                            f"overlapping delay rules cover directed "
+                            f"edge {s}->{d} in ticks "
+                            f"[{max(a.start_tick, b.start_tick)}, "
+                            f"{min(a.end_tick, b.end_tick)})")
 
 
 def random_adversary_schedule(n: int, seed: int, ticks: int,
@@ -509,11 +697,15 @@ class ScenarioWeights:
     flip_flop: float = 1.0
     contested: float = 1.0
     churn: float = 1.0
+    delay: float = 1.0
+    jitter: float = 1.0
+    slow_asym: float = 1.0
 
     def items(self) -> Tuple[Tuple[str, float], ...]:
         pairs = (("crash", self.crash), ("partition", self.partition),
                  ("flip_flop", self.flip_flop), ("contested", self.contested),
-                 ("churn", self.churn))
+                 ("churn", self.churn), ("delay", self.delay),
+                 ("jitter", self.jitter), ("slow_asym", self.slow_asym))
         out = tuple((k, w) for k, w in pairs if w > 0)
         if not out:
             raise ValueError("all scenario weights are zero")
@@ -521,6 +713,14 @@ class ScenarioWeights:
 
 
 DEFAULT_SCENARIO_WEIGHTS = ScenarioWeights()
+
+#: Every kind `sample_adversary_schedule` can draw, in ScenarioWeights
+#: field order — campaign forced-weight sweeps iterate this.
+SCENARIO_KINDS = ("crash", "partition", "flip_flop", "contested", "churn",
+                  "delay", "jitter", "slow_asym")
+
+#: The latency-family subset: members whose schedule carries DelayRules.
+DELAY_KINDS = ("delay", "jitter", "slow_asym")
 
 
 @dataclass(frozen=True)
@@ -548,17 +748,18 @@ def _sample_crash_burst(rng, n: int, fd_interval: int) -> List[Tuple[int, int]]:
 def sample_adversary_schedule(
         n: int, seed: int, ticks: int,
         weights: Optional[ScenarioWeights] = None,
-        fd_interval: int = 10) -> SampledScenario:
+        fd_interval: int = 10, ring_depth: int = 4) -> SampledScenario:
     """Seeded scenario-space sampler for Monte-Carlo fleet campaigns.
 
     Draws a scenario *kind* from ``weights`` and fills in its knobs
     (burst sizes, partition subsets and healing, flip-flop periods,
-    contested camp splits with explicit fallback delays) from the same
+    contested camp splits with explicit fallback delays, latency-family
+    delay/jitter/asymmetry bounded by ``ring_depth``) from the same
     ``random.Random(seed)`` stream — fully deterministic in ``seed``.
-    Every returned schedule passes ``validate_schedule`` (property-tested
-    in ``tests/test_fleet.py``). ``random_adversary_schedule`` above is
-    the fixed crash+partition mix the adversary tests pin; this sampler
-    is the campaign-facing superset.
+    Every returned schedule passes ``validate_schedule`` with the given
+    ``ring_depth`` (property-tested in ``tests/test_fleet.py``).
+    ``random_adversary_schedule`` above is the fixed crash+partition mix
+    the adversary tests pin; this sampler is the campaign-facing superset.
     """
     import random as _random
 
@@ -570,6 +771,7 @@ def sample_adversary_schedule(
     crashes: List[Tuple[int, int]] = []
     windows: List[LinkWindow] = []
     proposes: List[ScriptedPropose] = []
+    delays: List[DelayRule] = []
     wants_churn = False
     if kind == "crash":
         crashes = _sample_crash_burst(rng, n, fd_interval)
@@ -613,13 +815,55 @@ def sample_adversary_schedule(
         if rng.random() < 0.4:  # churn under a light late crash
             slot = rng.randrange(n)
             crashes = [(slot, rng.randint(1, max(1, fd_interval)))]
+    elif kind == "delay":
+        # A fixed-latency slow subset: every message into (and, half the
+        # time, out of) the subset arrives `base` ticks late. No jitter,
+        # so ordering is preserved — the pure tail-latency regime.
+        size = rng.randint(1, max(1, n // 4))
+        slow = frozenset(rng.sample(range(n), size))
+        rest = frozenset(range(n)) - slow
+        base = rng.randint(1, max(1, ring_depth - 1))
+        delays.append(DelayRule(
+            src_slots=rest, dst_slots=slow, delay_ticks=base,
+            reverse_delay_ticks=base if rng.random() < 0.5 else -1,
+            start_tick=rng.randint(0, fd_interval)))
+        # Every latency member pairs its rule with a crash burst so the
+        # regime exercises a full view change under latency — the
+        # campaign's per-regime ticks-to-first-decide tails come from
+        # these decides.
+        crashes = _sample_crash_burst(rng, n, fd_interval)
+    elif kind == "jitter":
+        # Bounded per-message jitter on a subset's inbound edges: draws
+        # differ tick to tick, so consecutive messages on one edge can
+        # swap arrival order — the reordering regime.
+        size = rng.randint(1, max(1, n // 4))
+        t = frozenset(rng.sample(range(n), size))
+        jit = rng.randint(1, max(1, ring_depth - 2))
+        base = rng.randint(0, ring_depth - 1 - jit)
+        delays.append(DelayRule(
+            src_slots=frozenset(range(n)) - t, dst_slots=t,
+            delay_ticks=base, jitter_ticks=jit,
+            reverse_delay_ticks=base if rng.random() < 0.5 else -1,
+            start_tick=rng.randint(0, fd_interval)))
+        crashes = _sample_crash_burst(rng, n, fd_interval)
+    elif kind == "slow_asym":
+        # Slow-link asymmetry: traffic toward one half is slower than the
+        # return path (possibly instant), mimicking a congested uplink.
+        half = frozenset(rng.sample(range(n), max(1, n // 2)))
+        fwd = rng.randint(1, max(1, ring_depth - 1))
+        rev = rng.choice([d for d in range(ring_depth) if d != fwd])
+        delays.append(DelayRule(
+            src_slots=frozenset(range(n)) - half, dst_slots=half,
+            delay_ticks=fwd, reverse_delay_ticks=rev,
+            start_tick=rng.randint(0, fd_interval)))
+        crashes = _sample_crash_burst(rng, n, fd_interval)
     else:  # pragma: no cover - items() only yields the kinds above
         raise AssertionError(kind)
 
     schedule = AdversarySchedule(
         n=n, crashes=tuple(crashes), windows=tuple(windows),
-        proposes=tuple(proposes), seed=seed)
-    validate_schedule(schedule)
+        proposes=tuple(proposes), seed=seed, delays=tuple(delays))
+    validate_schedule(schedule, ring_depth=ring_depth)
     return SampledScenario(kind=kind, schedule=schedule,
                            wants_churn=wants_churn)
 

@@ -186,12 +186,20 @@ class SimNetwork:
     def send(self, src: Endpoint, dst: Endpoint, request: RapidRequest,
              on_response: Optional[ReplyFn] = None,
              timeout_ticks: Optional[int] = None) -> None:
-        """Queue a message for delivery next tick."""
+        """Queue a message for delivery next tick (plus any link delay).
+
+        Delay rules are evaluated at *send* time — the latency of a link is
+        a property of the wire the message entered — while crashes, link
+        windows, and drops are evaluated at *delivery* time, exactly like
+        both engine referees. Jittered delays can reorder consecutive
+        messages on one edge; delivery within a tick stays in send order.
+        """
         self.counters.sent += 1
         phase = consensus_phase_of(request)
         if phase is not None:
             self.consensus_counters[f"{phase}_sent"] += 1
-        deliver_at = self.tick + 1
+        delay = self.fault_model.delay_of(src, dst, self.tick)
+        deliver_at = self.tick + 1 + delay
         self._in_flight.setdefault(deliver_at, []).append(
             (next(self._seq), src, dst, request, on_response)
         )
@@ -206,7 +214,11 @@ class SimNetwork:
                     state["done"] = True
                     self.counters.timeouts += 1
                     cb(None)
-            handle = self.scheduler.schedule(timeout_ticks + 1, timeout)
+            # The deadline clock starts when the message hits the far end
+            # of the wire: a slow link stretches the round-trip budget the
+            # same way on both referees (the engines arm their reply
+            # timers at delivery too).
+            handle = self.scheduler.schedule(timeout_ticks + 1 + delay, timeout)
             # Replace the callback with a once-only wrapper that defuses the timeout.
             def once(resp, state=state, cb=on_response, handle=handle):
                 if not state["done"]:

@@ -61,6 +61,15 @@ class Settings:
     # device OOM mid-campaign.
     receiver_capacity_cap: int = 1024
 
+    # Depth D of the per-receiver in-flight delivery ring: wire tensors
+    # carry a leading [D] axis indexed by arrival tick, so the largest
+    # extra link delay a schedule may draw (base + jitter bound) is
+    # D - 1. Static — changing it retraces — and budget-checked up front:
+    # ``faults.validate_schedule(ring_depth=...)`` raises a structured
+    # ``DelayBudgetError`` for schedules that do not fit. Depth 1 is the
+    # degenerate next-tick-only wire (no delay rules representable).
+    delivery_ring_depth: int = 4
+
     # --- observability (rapid_tpu.engine.invariants) ---
     # Compile the on-device protocol invariant monitor into the jitted
     # step. Static: flipping it retraces; False compiles the checks out
@@ -76,6 +85,10 @@ class Settings:
                 f"Arguments do not satisfy K >= H >= L > 0, K >= 3: "
                 f"(K: {self.K}, H: {self.H}, L: {self.L})"
             )
+        if self.delivery_ring_depth < 1:
+            raise ValueError(
+                f"delivery_ring_depth must be >= 1, got "
+                f"{self.delivery_ring_depth}")
 
     def with_(self, **kw) -> "Settings":
         return replace(self, **kw)

@@ -425,6 +425,16 @@ def _dist(values: Sequence[float]) -> Dict[str, object]:
             "p99": _nearest_rank(vals, 99), "max": vals[-1]}
 
 
+def regime_distributions(
+        ticks_by_regime: Dict[str, Sequence[float]]) -> Dict[str, object]:
+    """Nearest-rank ``ticks_to_first_decide`` distributions keyed by
+    delay regime (the campaign's schema-v6 ``delay_regimes`` block):
+    regime -> the same ``{count, p50, p90, p99, max}`` shape as every
+    other campaign distribution, where ``count`` is the number of
+    members of that regime that decided at all."""
+    return {k: _dist(v) for k, v in sorted(ticks_by_regime.items())}
+
+
 def summary_distributions(
         summaries: Sequence[RunSummary]) -> Dict[str, object]:
     """Campaign distributions over per-member summaries (Rapid §6 /

@@ -25,7 +25,7 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 #: Fold semantics of every RunSummary gauge when aggregated over a fleet
 #: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
@@ -183,7 +183,14 @@ CAMPAIGN_SPEC = {
     "per_receiver": (dict,),
     "spot_checks": (dict,),
     "distributions": (dict,),
+    "delay_regimes": (dict,),
 }
+
+#: Delay-regime keys the ``delay_regimes`` block may carry (schema v6):
+#: the latency-family scenario kinds plus the delay-free rest of the
+#: campaign. Each value is one DISTRIBUTION_SPEC block over that
+#: regime's per-member ticks-to-first-decide.
+DELAY_REGIMES = ("delay", "jitter", "slow_asym", "no_delay")
 
 #: Per-receiver dispatch block of a campaign payload (schema v4): how
 #: many members ran device-exact under link faults and the measured
@@ -195,6 +202,7 @@ PER_RECEIVER_SPEC = {
     "fleet_size": (int,),
     "capacity": (int,),
     "capacity_cap": (int,),
+    "ring_depth": (int,),
     "member_state_bytes": (int,),
     "kinds": (dict,),
 }
@@ -265,12 +273,14 @@ DISPATCH_RECORD_SPEC = {
 }
 
 #: Padding waste of one dispatch: inert rows added by ``stack_members``
-#: to reach the campaign-global maxima (link-window rows, fallback
-#: instance rows, fallback pid rows), summed over the fleet axis.
+#: / ``stack_receiver_members`` to reach the campaign-global maxima
+#: (link-window rows, fallback instance rows, fallback pid rows,
+#: provably-inert delay rules), summed over the fleet axis.
 DISPATCH_PADDING_SPEC = {
     "window_rows": (int,),
     "fallback_instances": (int,),
     "fallback_pids": (int,),
+    "delay_rules": (int,),
 }
 
 #: Device-memory watermark after one dispatch. ``live_buffer_bytes``
@@ -378,6 +388,15 @@ def validate_campaign(block, where: str = "campaign") -> List[str]:
             else:
                 errors += _check(dists[key], DISTRIBUTION_SPEC,
                                  f"{where}.distributions.{key}")
+    regimes = block.get("delay_regimes")
+    if isinstance(regimes, dict):
+        for key, dist in regimes.items():
+            if key not in DELAY_REGIMES:
+                errors.append(f"{where}.delay_regimes.{key}: unknown "
+                              f"regime (expected one of "
+                              f"{'/'.join(DELAY_REGIMES)})")
+            errors += _check(dist, DISTRIBUTION_SPEC,
+                             f"{where}.delay_regimes.{key}")
     return errors
 
 
@@ -550,7 +569,8 @@ def validate_bench_payload(payload) -> List[str]:
     if payload.get("bench") == "kernel_profile_sweep":
         return errors + validate_profile_payload(payload)
     if payload.get("bench") == "engine_tick_suite":
-        for key in ("steady", "churn", "contested", "partition", "fleet"):
+        for key in ("steady", "churn", "contested", "partition", "delay",
+                    "fleet"):
             if key not in payload:
                 errors.append(f"payload.{key}: missing")
             else:

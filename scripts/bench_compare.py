@@ -273,7 +273,8 @@ def compare_payloads(current: Dict, baseline: Dict,
     if cur_kind == "engine_tick_suite":
         errors: List[str] = []
         warnings: List[str] = []
-        for key in ("steady", "churn", "contested", "partition", "fleet"):
+        for key in ("steady", "churn", "contested", "partition", "delay",
+                    "fleet"):
             e, w = compare_run(current.get(key) or {},
                                baseline.get(key) or {},
                                f"payload.{key}", tps_tolerance)

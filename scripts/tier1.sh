@@ -88,7 +88,7 @@ if [ "$rc" -eq 0 ]; then
 fi
 
 # Dispatch-observatory smoke: a small campaign run with --trace and
-# --progress must emit (a) a schema-v5-valid payload whose
+# --progress must emit (a) a schema-valid payload whose
 # dispatch_timeline carries per-stage walls, (b) a parseable Perfetto
 # trace-event JSON, and (c) at least one JSONL heartbeat line. The
 # schema validator already enforces the stage-sum-vs-wall_s tolerance,
@@ -143,6 +143,42 @@ sys.exit(0 if ok else 1)'; then
         echo PARTITION_EXACT_SMOKE=ok
     else
         echo PARTITION_EXACT_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Delay-adversary smoke: a latency-only campaign (every member draws
+# fixed delay, bounded jitter, or slow-link asymmetry) must route all
+# members through the per-receiver delivery ring, emit a schema-v6
+# payload whose campaign.delay_regimes block carries non-empty
+# ticks-to-first-decide tails for at least two latency regimes, and
+# pass a delay-family spot check replayed bit-identically through
+# run_receiver_differential (--spot-checks 3 covers the required
+# partition/contested/delay kinds; the delay member comes from the
+# campaign's own pool).
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/bench_engine.py \
+            --scenario delay --clusters 6 --fleet-size 6 --n 48 --ticks 240 \
+            --spot-checks 3 --out /tmp/_t1_delay.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_delay.json \
+        && python -c '
+import json, sys
+camp = json.load(open("/tmp/_t1_delay.json"))["campaign"]
+regimes = camp["delay_regimes"]
+latency = [k for k in ("delay", "jitter", "slow_asym")
+           if regimes.get(k, {}).get("count", 0) >= 1]
+pr = camp["per_receiver"]
+spot = camp["spot_checks"]["members"]
+ok = (len(latency) >= 2
+      and pr["enabled"] and pr["ring_depth"] >= 1
+      and pr["members"] == camp["clusters"]
+      and any(m["kind"] in ("delay", "jitter", "slow_asym")
+              and m["mode"] == "per_receiver" and m["passed"]
+              for m in spot))
+sys.exit(0 if ok else 1)'; then
+        echo DELAY_SMOKE=ok
+    else
+        echo DELAY_SMOKE=failed
         rc=1
     fi
 fi

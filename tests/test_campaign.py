@@ -1,4 +1,4 @@
-"""Campaign driver: determinism, schema v5 payloads, and fleet folds.
+"""Campaign driver: determinism, schema v6 payloads, and fleet folds.
 
 The campaign block of a bench payload is exact-compared by
 ``scripts/bench_compare.py``, so everything derived from the campaign
@@ -15,7 +15,7 @@ import pytest
 
 from rapid_tpu.campaign import (MIN_MEASURABLE_WALL_S, CampaignConfig,
                                 run_campaign)
-from rapid_tpu.faults import ScenarioWeights
+from rapid_tpu.faults import SCENARIO_KINDS, ScenarioWeights
 from rapid_tpu.telemetry import metrics as tmetrics
 from rapid_tpu.telemetry import schema as tschema
 from rapid_tpu.telemetry.metrics import (RunSummary, merge_summaries,
@@ -33,6 +33,10 @@ WALL_KEYS = ("boot_s", "wall_s", "fold_s", "compile_s", "device_busy_s",
 DISPATCH_WALL_KEYS = ("stages", "wall_s", "clusters_per_sec",
                       "host_blocked_frac", "memory")
 
+#: TINY draws from the full default mix (all eight kinds, latency
+#: family included); seed 9 happens to sample latency members only, so
+#: every dispatch routes per-receiver — the observatory assertions
+#: below are written mode-generically.
 TINY = CampaignConfig(clusters=6, n=16, ticks=80, seed=9, fleet_size=3,
                       headroom=8, spot_checks=0)
 
@@ -41,8 +45,9 @@ TINY = CampaignConfig(clusters=6, n=16, ticks=80, seed=9, fleet_size=3,
 #: path) and two partition members (per-receiver path).
 STRADDLE = CampaignConfig(
     clusters=4, n=16, ticks=60, seed=1, fleet_size=2, headroom=8,
-    weights=ScenarioWeights(crash=1, partition=1, flip_flop=0,
-                            contested=0, churn=0))
+    weights=ScenarioWeights(
+        **{k: (1.0 if k in ("crash", "partition") else 0.0)
+           for k in SCENARIO_KINDS}))
 
 
 def _strip_wall(payload):
@@ -89,11 +94,14 @@ def test_campaign_is_deterministic_across_dispatches(tiny_payload,
     beats = [ln for ln in lines if ln["record"] == "dispatch"]
     assert len(beats) == len(again["dispatch_timeline"])
     assert beats[-1]["clusters_done"] == TINY.clusters
+    # spot checks run before any dispatch, so every heartbeat carries
+    # the real failure count (0 here: TINY requests no spot checks)
+    assert all(b["spot_failures"] == 0 for b in beats)
     assert lines[-1]["record"] == "campaign"
 
 
-def test_campaign_payload_passes_schema_v5(tiny_payload):
-    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 5
+def test_campaign_payload_passes_schema_v6(tiny_payload):
+    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 6
     assert tschema.validate_bench_payload(tiny_payload) == []
     camp = tiny_payload["campaign"]
     assert camp["clusters"] == TINY.clusters
@@ -110,6 +118,17 @@ def test_campaign_payload_passes_schema_v5(tiny_payload):
     assert sum(pr["kinds"].values()) == pr["members"]
     assert pr["member_state_bytes"] > 0
     assert pr["capacity"] >= TINY.n
+    # v6: the ring depth the dispatch was sized for, and per-regime
+    # decide tails keyed only by known regimes with one entry per
+    # latency kind that sampled members
+    assert pr["ring_depth"] == 4
+    regimes = camp["delay_regimes"]
+    assert set(regimes) <= set(tschema.DELAY_REGIMES)
+    latency_kinds = {k for k in camp["scenario_kinds"]
+                     if k in ("delay", "jitter", "slow_asym")}
+    assert latency_kinds <= set(regimes)
+    for dist in regimes.values():
+        assert set(dist) == {"count", "p50", "p90", "p99", "max"}
 
 
 def test_dispatch_timeline_observatory(tiny_payload):
@@ -147,9 +166,16 @@ def test_dispatch_timeline_observatory(tiny_payload):
         <= tiny_payload["wall_s"] + 1e-6
     assert obs["overlap_headroom_s"] <= min(obs["host_blocked_s"],
                                             obs["device_busy_s"]) + 1e-9
-    # TINY routes everything shared, so only that executable exists.
-    assert obs["compile"]["shared"] is not None
-    assert obs["compile"]["shared"]["compile_s"] > 0
+    # Every mode the timeline used compiled an executable in this
+    # process; unused modes stay None. (Seed 9 of the default mix draws
+    # latency members only, so TINY routes everything per-receiver.)
+    used_modes = {r["mode"] for r in timeline}
+    for mode in ("shared", "per_receiver"):
+        info = obs["compile"][mode]
+        if mode in used_modes:
+            assert info is not None and info["compile_s"] > 0
+        else:
+            assert info is None
     assert tiny_payload["clusters_per_sec"] is not None
     assert tiny_payload["total_s"] >= tiny_payload["wall_s"]
 

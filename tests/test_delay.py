@@ -1,0 +1,426 @@
+"""Latency adversary family: per-edge delay, jitter, and reordering.
+
+The acceptance contract of the delay tentpole, pinned here:
+
+- ``DelayRule`` validation is genuine-input-only plus one budget: a
+  rule whose worst-case draw cannot fit the delivery ring raises the
+  structured ``DelayBudgetError`` up front; overlapping directed-edge
+  coverage (including implied reverse directions) is rejected.
+- Ring boundary semantics are exact: a ``delay_ticks=0`` rule is
+  bit-identical to no rule at all; ``delay_ticks == D - 1`` rides the
+  ring horizon and still matches both referees; one past the horizon
+  refuses; a delay-free schedule is bit-identical across ring depths
+  (``D=1`` degenerates to the old next-tick wire).
+- Both referees stay exact under latency at N=64 (and N=256, slow):
+  ``run_adversarial_differential`` (host engine vs oracle) and
+  ``run_receiver_differential`` (device kernel vs host engine) for
+  delay-only, delay + partition, and asymmetric-jitter reordering.
+- A classic-Paxos fallback triggers *purely* from a slow link: a slow
+  voter subset delays fast votes past the fallback timer with zero
+  drops anywhere, and the classic 1a/1b/2a/2b chain decides —
+  bit-identical on both referees.
+- Inert delay-rule padding (``pad_delay_rules``, used by
+  ``stack_receiver_members`` to batch heterogeneous members) never
+  changes a member's outcome, bit for bit.
+"""
+import numpy as np
+import pytest
+
+from rapid_tpu.engine import fleet as fleet_mod
+from rapid_tpu.engine import receiver as rx_mod
+from rapid_tpu.engine.diff import (run_adversarial_differential,
+                                   run_receiver_differential)
+from rapid_tpu.faults import (AdversarySchedule, DelayBudgetError, DelayRule,
+                              LinkWindow, validate_schedule)
+from rapid_tpu.settings import Settings
+
+SETTINGS = Settings()
+RING = SETTINGS.delivery_ring_depth
+
+
+def _assert_exact(result):
+    result.assert_identical()
+    assert result.engine_phase_counters == result.oracle_phase_counters
+    assert result.engine_config_ids == result.oracle_config_ids
+
+
+def _assert_tree_equal(a, b, what):
+    import jax
+
+    leaves_a, tree_a = jax.tree_util.tree_flatten(a)
+    leaves_b, tree_b = jax.tree_util.tree_flatten(b)
+    assert tree_a == tree_b, f"{what}: treedefs diverged"
+    for i, (x, y) in enumerate(zip(leaves_a, leaves_b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{what}: leaf {i} diverged"
+
+
+def _events(result):
+    """Per-slot event streams as comparable tuples."""
+    return [[(e.kind, e.tick, e.config_id, tuple(e.slots))
+             for e in stream]
+            for stream in result.engine_events_by_slot]
+
+
+def _phase_total(result, key):
+    return sum(d[key] for d in result.engine_phase_counters)
+
+
+def _crash_sched(n, delays, seed=5, crash_slot=None, windows=()):
+    """A crash burst plus the given delay rules: the crash forces a view
+    change, so latency has protocol traffic to act on."""
+    slot = crash_slot if crash_slot is not None else n - 1
+    return AdversarySchedule(n=n, crashes=((slot, 11),), windows=windows,
+                             delays=tuple(delays), seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# validation: genuine input errors + the ring budget
+# ---------------------------------------------------------------------------
+
+
+def test_delay_rule_field_validation():
+    n = 8
+    all_slots = frozenset(range(n))
+
+    def _sched(rule):
+        return AdversarySchedule(n=n, delays=(rule,), seed=0)
+
+    with pytest.raises(ValueError, match="non-empty"):
+        validate_schedule(_sched(DelayRule(src_slots=frozenset(),
+                                           dst_slots=all_slots)))
+    with pytest.raises(ValueError, match="outside universe"):
+        validate_schedule(_sched(DelayRule(src_slots=frozenset({n + 3}),
+                                           dst_slots=all_slots)))
+    with pytest.raises(ValueError, match="delay_ticks must be >= 0"):
+        validate_schedule(_sched(DelayRule(src_slots=all_slots,
+                                           dst_slots=all_slots,
+                                           delay_ticks=-1)))
+    with pytest.raises(ValueError, match="jitter_ticks must be >= 0"):
+        validate_schedule(_sched(DelayRule(src_slots=all_slots,
+                                           dst_slots=all_slots,
+                                           jitter_ticks=-2)))
+    with pytest.raises(ValueError, match="reverse_delay_ticks"):
+        validate_schedule(_sched(DelayRule(src_slots=all_slots,
+                                           dst_slots=all_slots,
+                                           reverse_delay_ticks=-2)))
+    with pytest.raises(ValueError, match="zero-length delay rule"):
+        validate_schedule(_sched(DelayRule(src_slots=all_slots,
+                                           dst_slots=all_slots,
+                                           start_tick=30, end_tick=30)))
+
+
+def test_delay_budget_error_is_structured():
+    """Worst case = max(base, reverse) + jitter; one past ``D - 1``
+    raises the structured refusal, exactly at the horizon passes."""
+    n = 8
+    rule = DelayRule(src_slots=frozenset({0}), dst_slots=frozenset({1}),
+                     delay_ticks=2, jitter_ticks=2)
+    sched = AdversarySchedule(n=n, delays=(rule,), seed=0)
+    with pytest.raises(DelayBudgetError) as exc:
+        validate_schedule(sched, ring_depth=4)
+    err = exc.value
+    assert err.ring_depth == 4 and err.max_delay == 4
+    assert err.base_ticks == 2 and err.jitter_ticks == 2
+    assert "delivery_ring_depth" in str(err)
+    # same rule fits a deeper ring; no ring_depth means no budget check
+    validate_schedule(sched, ring_depth=5)
+    validate_schedule(sched)
+    # the reverse base counts toward the worst case too
+    rev = DelayRule(src_slots=frozenset({0}), dst_slots=frozenset({1}),
+                    delay_ticks=1, reverse_delay_ticks=3, jitter_ticks=1)
+    with pytest.raises(DelayBudgetError):
+        validate_schedule(AdversarySchedule(n=n, delays=(rev,), seed=0),
+                          ring_depth=4)
+    # DelayBudgetError is a ValueError: one except arm catches both
+    assert issubclass(DelayBudgetError, ValueError)
+
+
+def test_overlapping_delay_rules_rejected():
+    a = DelayRule(src_slots=frozenset({0, 1}), dst_slots=frozenset({2}),
+                  delay_ticks=1)
+    b = DelayRule(src_slots=frozenset({1}), dst_slots=frozenset({2, 3}),
+                  delay_ticks=2)
+    with pytest.raises(ValueError, match="overlapping delay rules"):
+        validate_schedule(AdversarySchedule(n=8, delays=(a, b), seed=0))
+    # disjoint tick ranges never overlap
+    validate_schedule(AdversarySchedule(
+        n=8, delays=(DelayRule(src_slots=frozenset({0, 1}),
+                               dst_slots=frozenset({2}),
+                               delay_ticks=1, end_tick=40),
+                     DelayRule(src_slots=frozenset({1}),
+                               dst_slots=frozenset({2, 3}),
+                               delay_ticks=2, start_tick=40)), seed=0))
+    # a rule's implied reverse direction counts as coverage
+    fwd = DelayRule(src_slots=frozenset({0}), dst_slots=frozenset({1}),
+                    delay_ticks=1, reverse_delay_ticks=2)
+    back = DelayRule(src_slots=frozenset({1}), dst_slots=frozenset({0}),
+                     delay_ticks=1)
+    with pytest.raises(ValueError, match="overlapping delay rules"):
+        validate_schedule(AdversarySchedule(n=8, delays=(fwd, back), seed=0))
+
+
+def test_lowering_refuses_over_budget_and_shared_path():
+    """Receiver lowering enforces the ring budget of the settings it is
+    handed; the shared-state lowering refuses delay schedules outright
+    (the shared wire cannot represent per-edge delays)."""
+    rule = DelayRule(src_slots=frozenset({0}), dst_slots=frozenset({1}),
+                     delay_ticks=RING)  # max_delay == RING > RING - 1
+    sched = _crash_sched(8, [rule])
+    with pytest.raises(DelayBudgetError):
+        fleet_mod.lower_receiver_schedule(sched, SETTINGS)
+    with pytest.raises(DelayBudgetError):
+        run_receiver_differential(sched, 40, SETTINGS)
+    ok = _crash_sched(8, [DelayRule(src_slots=frozenset({0}),
+                                    dst_slots=frozenset({1}),
+                                    delay_ticks=1)])
+    with pytest.raises(ValueError, match="lower_receiver_schedule"):
+        fleet_mod.lower_schedule(ok, SETTINGS)
+
+
+# ---------------------------------------------------------------------------
+# ring boundary semantics
+# ---------------------------------------------------------------------------
+
+
+def test_delay_zero_is_bit_identical_to_no_rule():
+    """A ``delay_ticks=0`` rule must be a provable no-op: same event
+    streams, config ids and per-phase counters as the same schedule
+    with no delays at all — through the device referee."""
+    n = 16
+    zero = DelayRule(src_slots=frozenset(range(6)),
+                     dst_slots=frozenset(range(6, n)), delay_ticks=0)
+    with_rule = run_receiver_differential(_crash_sched(n, [zero]), 160,
+                                          SETTINGS)
+    without = run_receiver_differential(_crash_sched(n, []), 160, SETTINGS)
+    _assert_exact(with_rule)
+    _assert_exact(without)
+    assert _events(with_rule) == _events(without)
+    assert with_rule.engine_config_ids == without.engine_config_ids
+    assert with_rule.engine_phase_counters == without.engine_phase_counters
+
+
+def test_delay_at_ring_horizon_is_exact():
+    """``delay_ticks == D - 1`` occupies the deepest ring slot a message
+    can take; both referees must still agree bit for bit, and the run
+    must actually decide (the delay shifts, not starves, the decide)."""
+    n = 16
+    horizon = DelayRule(src_slots=frozenset(range(5)),
+                        dst_slots=frozenset(range(5, n)),
+                        delay_ticks=RING - 1)
+    sched = _crash_sched(n, [horizon])
+    dev = run_receiver_differential(sched, 200, SETTINGS)
+    _assert_exact(dev)
+    _assert_exact(run_adversarial_differential(sched, 200, SETTINGS))
+    assert any(e.kind == "view_change"
+               for e in dev.engine_events_by_slot[0])
+
+
+def test_delay_free_schedule_identical_across_ring_depths():
+    """``D=1`` degenerates to the old next-tick wire: a delay-free
+    schedule must produce bit-identical streams at D=1 and the default
+    depth (the ring axis is inert when nothing draws a delay)."""
+    sched = _crash_sched(16, [])
+    deep = run_receiver_differential(sched, 160, SETTINGS)
+    shallow = run_receiver_differential(
+        sched, 160, SETTINGS.with_(delivery_ring_depth=1))
+    _assert_exact(deep)
+    _assert_exact(shallow)
+    assert _events(deep) == _events(shallow)
+    assert deep.engine_config_ids == shallow.engine_config_ids
+    assert deep.engine_phase_counters == shallow.engine_phase_counters
+
+
+# ---------------------------------------------------------------------------
+# N=64 differentials: delay-only, delay+partition, jitter reorder
+# ---------------------------------------------------------------------------
+
+
+def test_delay_only_differentials_n64():
+    n = 64
+    rule = DelayRule(src_slots=frozenset(range(12)),
+                     dst_slots=frozenset(range(12, n)), delay_ticks=2)
+    sched = _crash_sched(n, [rule])
+    _assert_exact(run_adversarial_differential(sched, 200, SETTINGS))
+    _assert_exact(run_receiver_differential(sched, 200, SETTINGS))
+
+
+def test_delay_plus_partition_differentials_n64():
+    """Latency composes with drops: a one-way partition isolates one
+    group while a disjoint edge set runs slow — delivery-tick drop
+    evaluation and send-tick delay evaluation must not interfere."""
+    n = 64
+    iso = frozenset(range(52, 64))
+    rest = frozenset(range(52))
+    sched = AdversarySchedule(
+        n=n,
+        windows=(LinkWindow(src_slots=rest, dst_slots=iso, start_tick=6),),
+        delays=(DelayRule(src_slots=frozenset(range(10)),
+                          dst_slots=frozenset(range(10, 40)),
+                          delay_ticks=2, jitter_ticks=1),),
+        seed=17)
+    host = run_adversarial_differential(sched, 240, SETTINGS)
+    _assert_exact(host)
+    _assert_exact(run_receiver_differential(sched, 240, SETTINGS))
+    # the partition must have actually dropped traffic — latency never
+    # drops anything, so every drop here is the window's
+    assert sum(r.link_dropped for r in host.engine_metrics) > 0
+
+
+def test_asymmetric_jitter_reorder_differentials_n64():
+    """Jitter on an asymmetric edge set reorders messages in flight;
+    receivers must process them in announce order on both referees —
+    and the jitter must actually spread arrivals (non-zero bound with
+    a base of zero exercises pure reordering)."""
+    n = 64
+    rule = DelayRule(src_slots=frozenset(range(8)),
+                     dst_slots=frozenset(range(8, n)),
+                     delay_ticks=0, jitter_ticks=2,
+                     reverse_delay_ticks=1)
+    sched = _crash_sched(n, [rule], seed=23)
+    _assert_exact(run_adversarial_differential(sched, 200, SETTINGS))
+    _assert_exact(run_receiver_differential(sched, 200, SETTINGS))
+
+
+# ---------------------------------------------------------------------------
+# the headline: a classic fallback decided purely by a slow link
+# ---------------------------------------------------------------------------
+
+
+def _slow_voters_sched(n, n_slow, delay, start=100, seed=9):
+    """Crash slot 5; make the top ``n_slow`` slots slow enough that the
+    fast round misses quorum until the organic fallback timer fires.
+    The rule starts after boot convergence (tick 100) so only the
+    post-crash consensus traffic rides the slow link. No windows, no
+    drops — latency is the only adversary surface."""
+    slow = frozenset(range(n - n_slow, n))
+    return AdversarySchedule(
+        n=n, crashes=((5, 11),),
+        delays=(DelayRule(src_slots=slow, dst_slots=frozenset(range(n)),
+                          delay_ticks=delay, start_tick=start),),
+        seed=seed)
+
+
+def test_slow_link_triggers_classic_fallback_n16():
+    """6 of 15 surviving voters delayed 30 ticks: only 9 on-time fast
+    votes circulate, short of the fast quorum of 13, so the decision
+    must come from the classic 1a/1b/2a/2b chain — with zero drops
+    anywhere (latency alone caused the fallback), on both referees.
+    Empirically: proposal at 152, classic decide at 176 (the fast path
+    alone decides at 123)."""
+    n, ring = 16, 32
+    settings = SETTINGS.with_(delivery_ring_depth=ring)
+    sched = _slow_voters_sched(n, 6, 30)
+    host = run_adversarial_differential(sched, 400, settings)
+    _assert_exact(host)
+    dev = run_receiver_differential(sched, 400, settings)
+    _assert_exact(dev)
+    for phase in ("phase1a_sent", "phase1b_sent", "phase2a_sent",
+                  "phase2b_sent"):
+        assert _phase_total(dev, phase) > 0, f"{phase} never fired"
+    assert sum(r.link_dropped for r in host.engine_metrics) == 0
+    # the survivors converge on one post-crash view cutting slot 5
+    vcs = [e for e in dev.engine_events_by_slot[0] if e.kind == "view_change"]
+    assert vcs and {s for vc in vcs for s in vc.slots} == {5}
+
+
+def test_slow_link_triggers_classic_fallback_n64():
+    """Same mechanism at N=64: 16 slow voters of 63 survivors leave 47
+    on-time fast votes, short of the fast quorum of 49. Delay 40 keeps
+    the late votes clear of the classic round's own messages (see
+    test_cross_phase_reorder_is_refused_not_diverged for what happens
+    when they collide)."""
+    n, ring = 64, 48
+    settings = SETTINGS.with_(delivery_ring_depth=ring)
+    sched = _slow_voters_sched(n, 16, 40)
+    host = run_adversarial_differential(sched, 400, settings)
+    _assert_exact(host)
+    dev = run_receiver_differential(sched, 400, settings)
+    _assert_exact(dev)
+    for phase in ("phase1a_sent", "phase1b_sent", "phase2a_sent",
+                  "phase2b_sent"):
+        assert _phase_total(dev, phase) > 0, f"{phase} never fired"
+    assert sum(r.link_dropped for r in host.engine_metrics) == 0
+
+
+def test_cross_phase_reorder_is_refused_not_diverged():
+    """Delay 30 at N=64 lands the slow voters' fast votes on the same
+    arrival tick as the classic round's freshly-sent phase-2a: oracle
+    wseq order processes the older votes first, which the kernel's
+    fixed group order cannot reproduce. The kernel must refuse with the
+    sticky cross-phase flag — never report a silently divergent run —
+    while the host referee stays oracle-exact on the same schedule."""
+    n, ring = 64, 32
+    settings = SETTINGS.with_(delivery_ring_depth=ring)
+    sched = _slow_voters_sched(n, 16, 30)
+    _assert_exact(run_adversarial_differential(sched, 400, settings))
+    with pytest.raises(rx_mod.ReceiverEnvelopeError,
+                       match="cross-phase-send-order-inversion"):
+        run_receiver_differential(sched, 400, settings)
+
+
+# ---------------------------------------------------------------------------
+# N=256, slow-marked
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_delay_family_differentials_n256():
+    n = 256
+    delay_only = _crash_sched(
+        n, [DelayRule(src_slots=frozenset(range(30)),
+                      dst_slots=frozenset(range(30, n)),
+                      delay_ticks=2, jitter_ticks=1)], seed=41)
+    _assert_exact(run_adversarial_differential(delay_only, 200, SETTINGS))
+    _assert_exact(run_receiver_differential(delay_only, 200, SETTINGS))
+
+
+@pytest.mark.slow
+def test_slow_link_classic_fallback_n256():
+    """Fast quorum at N=256 is 193 of 255 survivors; 64 slow voters
+    leave 191 on-time votes — two short — so latency alone forces the
+    classic chain at fleet-representative scale.  The delay must beat
+    the earliest recovery-timer draw (~64 ticks after the proposal at
+    this scale and seed — expovariate jitter scales with N), or the
+    late votes complete the fast quorum before any timer fires."""
+    n, ring = 256, 96
+    settings = SETTINGS.with_(delivery_ring_depth=ring)
+    sched = _slow_voters_sched(n, 64, 80)
+    host = run_adversarial_differential(sched, 400, settings)
+    _assert_exact(host)
+    dev = run_receiver_differential(sched, 400, settings)
+    _assert_exact(dev)
+    assert _phase_total(dev, "phase1a_sent") > 0
+    assert _phase_total(dev, "phase2b_sent") > 0
+    assert sum(r.link_dropped for r in host.engine_metrics) == 0
+
+
+# ---------------------------------------------------------------------------
+# inert padding
+# ---------------------------------------------------------------------------
+
+
+def test_pad_delay_rules_is_inert_bit_identically():
+    """Padding a member's delay rules (as ``stack_receiver_members``
+    does to batch heterogeneous fleets) never changes its outcome —
+    growing from zero rules materializes the seed limbs and all-false
+    masks, growing an existing set appends inert rows."""
+    n, ticks = 16, 120
+    no_delay = fleet_mod.lower_receiver_schedule(
+        _crash_sched(n, [], seed=3), SETTINGS)
+    with_delay = fleet_mod.lower_receiver_schedule(
+        _crash_sched(n, [DelayRule(src_slots=frozenset(range(4)),
+                                   dst_slots=frozenset(range(4, n)),
+                                   delay_ticks=1, jitter_ticks=1)],
+                     seed=3), SETTINGS)
+    for member, grown in ((no_delay, 3), (with_delay, 4)):
+        base_final, base_logs = rx_mod.receiver_simulate(
+            member.state, member.faults, ticks, SETTINGS)
+        padded = fleet_mod.pad_delay_rules(member.faults, grown)
+        assert padded.n_delay_rules == grown
+        pad_final, pad_logs = rx_mod.receiver_simulate(
+            member.state, padded, ticks, SETTINGS)
+        _assert_tree_equal(pad_final, base_final, "padded final state")
+        _assert_tree_equal(pad_logs, base_logs, "padded logs")
+    with pytest.raises(ValueError):
+        fleet_mod.pad_delay_rules(with_delay.faults, 0)  # shrink refused

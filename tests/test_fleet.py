@@ -27,7 +27,7 @@ from rapid_tpu.engine.step import simulate
 # rapid_tpu.engine re-exports the `step` *function*, which shadows the
 # module under `from rapid_tpu.engine import step`.
 step_mod = importlib.import_module("rapid_tpu.engine.step")
-from rapid_tpu.faults import (AdversarySchedule, LinkWindow,
+from rapid_tpu.faults import (SCENARIO_KINDS, AdversarySchedule, LinkWindow,
                               ScenarioWeights, ScriptedPropose,
                               random_adversary_schedule,
                               sample_adversary_schedule, validate_schedule)
@@ -36,6 +36,12 @@ from rapid_tpu.settings import Settings
 SETTINGS = Settings()
 N = 16
 TICKS = 120
+
+
+def _only(kind: str) -> ScenarioWeights:
+    """Weights drawing exclusively ``kind`` (every other kind zeroed)."""
+    return ScenarioWeights(**{k: (1.0 if k == kind else 0.0)
+                              for k in SCENARIO_KINDS})
 
 
 def _contested_schedule(n: int, seed: int = 11) -> AdversarySchedule:
@@ -151,23 +157,24 @@ def test_pad_link_windows_rejects_shrink():
 
 
 def test_sampled_schedules_all_validate():
-    """Property: every draw passes validate_schedule, over many seeds,
-    sizes and tick budgets; the default mix covers every kind."""
+    """Property: every draw passes validate_schedule — including the
+    delivery-ring budget check the sampler must respect — over many
+    seeds, sizes and tick budgets; the default mix covers every kind,
+    latency family included."""
+    ring = SETTINGS.delivery_ring_depth
     kinds = set()
     for n, ticks in ((8, 60), (32, 300)):
         for seed in range(150):
-            sc = sample_adversary_schedule(n, seed, ticks)
-            validate_schedule(sc.schedule)  # must not raise
+            sc = sample_adversary_schedule(n, seed, ticks, ring_depth=ring)
+            validate_schedule(sc.schedule, ring_depth=ring)  # must not raise
             assert sc.schedule.n == n
             assert sc.schedule.seed == seed
             kinds.add(sc.kind)
-    assert kinds == {"crash", "partition", "flip_flop", "contested",
-                     "churn"}
+    assert kinds == set(SCENARIO_KINDS)
 
 
 def test_sampler_respects_weights_and_is_deterministic():
-    only_contested = ScenarioWeights(crash=0, partition=0, flip_flop=0,
-                                     contested=1, churn=0)
+    only_contested = _only("contested")
     for seed in range(40):
         sc = sample_adversary_schedule(N, seed, 200, only_contested)
         assert sc.kind == "contested"
@@ -175,16 +182,36 @@ def test_sampler_respects_weights_and_is_deterministic():
         again = sample_adversary_schedule(N, seed, 200, only_contested)
         assert again == sc
     with pytest.raises(ValueError):
-        ScenarioWeights(crash=0, partition=0, flip_flop=0, contested=0,
-                        churn=0).items()
+        ScenarioWeights(**{k: 0.0 for k in SCENARIO_KINDS}).items()
 
 
 def test_churn_kind_flags_wants_churn():
-    only_churn = ScenarioWeights(crash=0, partition=0, flip_flop=0,
-                                 contested=0, churn=1)
-    sc = sample_adversary_schedule(N, 0, 200, only_churn)
+    sc = sample_adversary_schedule(N, 0, 200, _only("churn"))
     assert sc.kind == "churn" and sc.wants_churn
     assert not sc.schedule.windows and not sc.schedule.proposes
+
+
+def test_latency_kinds_sample_in_envelope():
+    """Property: every latency-family draw carries at least one delay
+    rule whose worst case fits the ring it was sampled for, pairs a
+    crash burst with the rule (so the member decides *under* latency),
+    and the kind-specific shape holds: ``jitter`` draws a non-zero
+    jitter bound, ``slow_asym`` a differing reverse base."""
+    ring = SETTINGS.delivery_ring_depth
+    for kind in ("delay", "jitter", "slow_asym"):
+        for seed in range(25):
+            sc = sample_adversary_schedule(N, seed, 200, _only(kind),
+                                           ring_depth=ring)
+            assert sc.kind == kind
+            assert sc.schedule.delays and sc.schedule.crashes
+            validate_schedule(sc.schedule, ring_depth=ring)
+            for r in sc.schedule.delays:
+                assert r.max_delay() <= ring - 1
+                if kind == "jitter":
+                    assert r.jitter_ticks > 0
+                if kind == "slow_asym":
+                    assert r.reverse_delay_ticks >= 0
+                    assert r.reverse_delay_ticks != r.delay_ticks
 
 
 def test_validate_schedule_rejects_malformed_windows():

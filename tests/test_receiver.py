@@ -31,7 +31,7 @@ from rapid_tpu.engine import receiver as rx_mod
 from rapid_tpu.engine.diff import (run_adversarial_differential,
                                    run_receiver_differential)
 from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
-from rapid_tpu.faults import (AdversarySchedule, LinkWindow,
+from rapid_tpu.faults import (SCENARIO_KINDS, AdversarySchedule, LinkWindow,
                               ScenarioWeights, ScriptedPropose,
                               sample_adversary_schedule)
 from rapid_tpu.settings import Settings
@@ -101,9 +101,7 @@ def test_classic_chain_partition_exercises_all_phases():
 @pytest.mark.parametrize("kind", ["partition", "flip_flop"])
 def test_sampled_link_fault_schedules_are_device_exact(kind):
     weights = ScenarioWeights(
-        **{k: (1.0 if k == kind else 0.0)
-           for k in ("crash", "partition", "flip_flop", "contested",
-                     "churn")})
+        **{k: (1.0 if k == kind else 0.0) for k in SCENARIO_KINDS})
     for seed in range(6):
         sc = sample_adversary_schedule(16, seed, TICKS, weights)
         assert sc.kind == kind
@@ -164,20 +162,30 @@ def test_link_window_boundary_semantics_n64():
 
 def test_fleet_slice_matches_unbatched_receiver_run():
     """Member i of a stacked per-receiver fleet == the same scenario
-    run through ``receiver_simulate`` alone, bit for bit."""
-    weights = ScenarioWeights(crash=0, partition=1, flip_flop=1,
-                              contested=0, churn=0)
-    schedules = [sample_adversary_schedule(16, s, 80, weights).schedule
+    run through ``receiver_simulate`` alone, bit for bit. The mix
+    includes a latency member so the stack pads delay rules across
+    link-fault-only members (inert padding must never change them)."""
+    link_weights = ScenarioWeights(
+        **{k: (1.0 if k in ("partition", "flip_flop") else 0.0)
+           for k in SCENARIO_KINDS})
+    jitter_weights = ScenarioWeights(
+        **{k: (1.0 if k == "jitter" else 0.0) for k in SCENARIO_KINDS})
+    schedules = [sample_adversary_schedule(16, s, 80, link_weights).schedule
                  for s in (2, 5, 9)]
+    schedules.append(sample_adversary_schedule(
+        16, 4, 80, jitter_weights,
+        ring_depth=SETTINGS.delivery_ring_depth).schedule)
     members = [fleet_mod.lower_receiver_schedule(s, SETTINGS)
                for s in schedules]
     fleet = fleet_mod.stack_receiver_members(members)
+    w = int(fleet.faults.link_src.shape[1])
+    r = int(fleet.faults.delay_src.shape[1])
     f_finals, f_logs = fleet_mod.receiver_fleet_simulate(fleet, 80,
                                                          SETTINGS)
     for i, m in enumerate(members):
         s_final, s_logs = rx_mod.receiver_simulate(
-            m.state, fleet_mod.pad_link_windows(
-                m.faults, int(fleet.faults.link_src.shape[1])),
+            m.state, fleet_mod.pad_delay_rules(
+                fleet_mod.pad_link_windows(m.faults, w), r),
             80, SETTINGS)
         sl_final = jax.tree_util.tree_map(lambda x, i=i: x[i], f_finals)
         sl_logs = jax.tree_util.tree_map(lambda x, i=i: x[i], f_logs)
@@ -201,9 +209,11 @@ def test_lower_receiver_schedule_rejects_proposes():
 # ---------------------------------------------------------------------------
 
 
-def test_field_shapes_pin_real_state():
+@pytest.mark.parametrize("ring_depth", [1, 4, 6])
+def test_field_shapes_pin_real_state(ring_depth):
     """Every entry of the sizing table matches a real instantiation —
-    shape and itemsize — so ``receiver_state_bytes`` cannot drift."""
+    shape and itemsize, across delivery-ring depths (the ring scales
+    the wire planes by D) — so ``receiver_state_bytes`` cannot drift."""
     from rapid_tpu.oracle.membership_view import id_fingerprint, uid_of
     from rapid_tpu.engine.diff import default_endpoints, default_node_ids
 
@@ -211,9 +221,12 @@ def test_field_shapes_pin_real_state():
     uids = [uid_of(e) for e in default_endpoints(n)]
     fp = sum(id_fingerprint(i) for i in default_node_ids(n)) \
         & ((1 << 64) - 1)
-    rs = rx_mod.init_receiver_state(uids, fp, SETTINGS.with_(capacity=n),
-                                    seed=0)
-    table = rx_mod.receiver_field_shapes(n, SETTINGS.K)
+    rs = rx_mod.init_receiver_state(
+        uids, fp,
+        SETTINGS.with_(capacity=n, delivery_ring_depth=ring_depth),
+        seed=0)
+    table = rx_mod.receiver_field_shapes(n, SETTINGS.K,
+                                         ring_depth=ring_depth)
     total = 0
     for field, leaf in zip(type(rs)._fields, rs):
         shape, itemsize = table[field]
@@ -222,7 +235,8 @@ def test_field_shapes_pin_real_state():
         assert arr.dtype.itemsize == itemsize, \
             f"{field}: itemsize {arr.dtype.itemsize} != {itemsize}"
         total += arr.nbytes
-    assert total == rx_mod.receiver_state_bytes(n, SETTINGS.K)
+    assert total == rx_mod.receiver_state_bytes(n, SETTINGS.K,
+                                                ring_depth=ring_depth)
 
 
 def test_budget_gate_raises_structured_error():

@@ -125,8 +125,14 @@ def main(argv=None) -> int:
                              "(default: stdout)")
     args = parser.parse_args(argv)
 
+    from rapid_tpu.engine.fleet import enable_compile_cache
     from rapid_tpu.settings import Settings
     from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+    # Before the first compile: XLA's persistent cache binds at the
+    # process's first compilation, so enabling it here covers every
+    # suite entry (the campaign entries re-enable idempotently).
+    enable_compile_cache()
 
     settings = Settings()
     payload = {

@@ -48,20 +48,42 @@ only when failures exceed ``--max-spot-failures`` (default 0 keeps the
 old strictness). This referee loop is the only host-side part of a
 campaign.
 
-Dispatch observatory (schema v6): every stage of every dispatch —
-schedule sampling, member lowering, ``stack_members`` padding, the
-one-time AOT XLA compile (``fleet.fleet_aot_compile``; later dispatches
-of the same mode reuse the executable with zero compile wall), the
-fenced device execute, and the summary fold — is timed into one
-``dispatch_timeline`` record per dispatch, with member-kind mix,
-padding waste against the campaign-global stacking maxima, host-blocked
-fraction, and a device-memory watermark. The top-level ``observatory``
-block folds those into host-blocked vs device-busy wall accounting
-(the double-buffering headroom figure), and ``clusters_per_sec`` is the
-campaign throughput row ``scripts/bench_compare.py`` gates. ``--trace``
-exports the same stages as Perfetto wall-clock spans
-(``telemetry.trace``); ``--progress`` emits one JSONL heartbeat line
-per completed dispatch so long campaigns are monitorable.
+Pipelined pooled dispatch (schema v7): members are first bucketed into
+**kind-homogeneous pools** by schedule shape signature — shared members
+split on (has link windows, has contested pids), per-receiver members
+on (has link windows, has delay rules) — so a contested-heavy member no
+longer inflates every crash-only member's fallback table and a
+delay-only member compiles the window machinery out entirely. Each pool
+stacks to its own maxima, gets its own AOT executable (compiled once,
+cached per pool; trailing chunks are cache hits), and its padding waste
+collapses to in-pool slack. The driver then runs the dispatch plan as a
+**double-buffered pipeline**: executables are compiled with donated
+carries and launched asynchronously (JAX dispatch returns immediately),
+the fence moves to result-fold time, and while dispatch d executes on
+device the host lowers/stacks/compiles dispatch d+1 — so
+``host_blocked_s`` overlaps ``device_busy_s`` and the observatory's
+``overlap_headroom_s`` is reclaimed instead of merely measured.
+``--no-pipeline`` runs the identical plan serially (fence right after
+launch); both drivers produce bit-identical payloads in every non-wall
+field, which ``tests/test_campaign.py`` pins. ``--fleet-shard D``
+additionally shards the fleet axis of every dispatch over ``D`` devices
+(``engine.sharding.fleet_axis_mesh`` — whole members per device, no
+collectives, bit-identical results).
+
+Dispatch observatory: every stage of every dispatch — schedule
+sampling, member lowering, stacking, the per-pool AOT XLA compile, the
+(now async) execute with its residual fence wait, and the summary fold
+— is timed into one ``dispatch_timeline`` record per dispatch, with
+member-kind mix, pool identity and shape, padding waste against the
+pool maxima, host-blocked fraction, and a device-memory watermark. The
+top-level ``observatory`` block folds those into host-blocked vs
+device-busy wall accounting plus the pipeline/pool summaries, and
+``clusters_per_sec`` is the campaign throughput row
+``scripts/bench_compare.py`` gates. ``--trace`` exports the same stages
+as Perfetto wall-clock spans (``telemetry.trace``); ``--progress``
+emits one JSONL heartbeat line per completed dispatch — now carrying
+``pool_id``/``pool_shape`` and ``in_flight_dispatches`` — so long
+pipelined campaigns are observable mid-run.
 
 CLI::
 
@@ -99,6 +121,13 @@ REQUIRED_SPOT_KINDS = ("partition", "contested", "delay")
 #: derived from them (``ticks_per_sec``, ``clusters_per_sec``) are
 #: reported as ``null`` instead of a garbage division.
 MIN_MEASURABLE_WALL_S = 1e-3
+
+#: Launched-but-unretired dispatches the pipelined driver keeps in
+#: flight: classic double buffering — dispatch d executes on device
+#: while the host samples/lowers/stacks/compiles d+1. Deeper queues buy
+#: nothing (the host prep of d+1 is the only work to overlap) and
+#: multiply the live working set.
+PIPELINE_DEPTH = 2
 
 
 def _rate(numerator: float, wall_s: float) -> Optional[float]:
@@ -161,6 +190,20 @@ class CampaignConfig:
     max_spot_failures: int = 0
     # Where divergence artifacts land (default: the system temp dir).
     artifact_dir: Optional[str] = None
+    # Double-buffered dispatch: launch asynchronously with donated
+    # carries and fence at fold time, overlapping device execution with
+    # the next dispatch's host prep. False runs the identical plan
+    # serially; every non-wall payload field is bit-identical either way.
+    pipeline: bool = True
+    # Shard the fleet axis of every dispatch over this many devices
+    # (engine.sharding.fleet_axis_mesh: whole members per device, no
+    # collectives). None keeps single-device dispatch.
+    fleet_shard: Optional[int] = None
+    # Persist pool executables to the on-disk XLA compilation cache
+    # (engine.fleet.enable_compile_cache): re-running a campaign loads
+    # each pool's program from disk instead of re-running LLVM. Same
+    # programs bit-for-bit — only compile wall changes.
+    compile_cache: bool = True
 
 
 def _receiver_eligible(sc: SampledScenario) -> bool:
@@ -222,6 +265,85 @@ def _lower_shared(cfg: CampaignConfig, settings: Settings, idx: int,
 
 def _chunks(seq: List[int], size: int) -> List[List[int]]:
     return [seq[i:i + size] for i in range(0, len(seq), size)]
+
+
+# --- kind-homogeneous dispatch pools --------------------------------------
+#
+# Stacking pads every member to the dispatch maxima, so one
+# contested-heavy member used to inflate every crash-only member's
+# fallback table (90+ inert pid rows per shared dispatch in the v6
+# baseline) and one partition member taxed every delay member with dead
+# window planes. Pools bucket members by *shape signature* — which
+# padded dimensions are live at all — before stacking: within a pool
+# the maxima are tight, and a small-signature pool's executable
+# compiles the dead machinery out entirely. Signatures derive from the
+# sampled schedule alone (no lowering needed), so the dispatch plan is
+# known up front and is bit-deterministic in the campaign seed.
+
+def _shared_dims(sc: SampledScenario) -> Tuple[int, int, int]:
+    """(window_rows, fallback_instances, fallback_pids) a shared member
+    lowers to — mirrors ``fleet.lower_schedule``/``_compile_proposes``
+    exactly (``stack_members`` re-derives and cross-checks them)."""
+    values = {tuple(p.proposal) for p in sc.schedule.proposes}
+    return (len(sc.schedule.windows), 1, max(1, len(values)))
+
+
+def _rx_dims(sc: SampledScenario) -> Tuple[int, int]:
+    """(window_rows, delay_rules) a per-receiver member lowers to."""
+    return (len(sc.schedule.windows), len(sc.schedule.delays))
+
+
+def _shared_pool_key(dims: Tuple[int, int, int]) -> Tuple[bool, bool]:
+    """Shared shape signature: (has link windows, has contested pids)."""
+    return (dims[0] > 0, dims[2] > 1)
+
+
+def _rx_pool_key(dims: Tuple[int, int]) -> Tuple[bool, bool]:
+    """Per-receiver shape signature: (has link windows, has delays)."""
+    return (dims[0] > 0, dims[1] > 0)
+
+
+def _pool_shape_dict(mode: str, shape: Tuple[int, ...]) -> Dict[str, int]:
+    """A pool's stacking maxima in the padding-record key space."""
+    if mode == "shared":
+        return {"window_rows": shape[0], "fallback_instances": shape[1],
+                "fallback_pids": shape[2], "delay_rules": 0}
+    return {"window_rows": shape[0], "fallback_instances": 0,
+            "fallback_pids": 0, "delay_rules": shape[1]}
+
+
+def _build_pools(scenarios: List[SampledScenario], sh_idx: List[int],
+                 rx_idx: List[int], f: int) -> List[Dict[str, object]]:
+    """Group members into (mode, shape-signature) pools.
+
+    Pools are ordered shared-first then per-receiver, each by sorted
+    signature; members keep campaign index order within a pool — all
+    deterministic in the sampled scenarios, so serial and pipelined
+    drivers (and repeated runs) share one dispatch plan. Each pool's
+    fleet size is capped at its own membership so a three-member pool
+    compiles a three-member executable, not a padded campaign-wide one.
+    """
+    pools: List[Dict[str, object]] = []
+
+    def add(mode, idxs, dims_fn, key_fn):
+        dims_map = {i: dims_fn(scenarios[i]) for i in idxs}
+        groups: Dict[Tuple[bool, ...], List[int]] = {}
+        for i in idxs:
+            groups.setdefault(key_fn(dims_map[i]), []).append(i)
+        for key in sorted(groups):
+            members = groups[key]
+            ndim = len(dims_map[members[0]])
+            shape = tuple(max(dims_map[i][j] for i in members)
+                          for j in range(ndim))
+            pools.append({
+                "pool_id": len(pools), "mode": mode, "members": members,
+                "dims": {i: dims_map[i] for i in members},
+                "shape": shape, "fleet_size": min(f, len(members)),
+            })
+
+    add("shared", sh_idx, _shared_dims, _shared_pool_key)
+    add("per_receiver", rx_idx, _rx_dims, _rx_pool_key)
+    return pools
 
 
 def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
@@ -356,30 +478,36 @@ def _device_peak_bytes(jax) -> Optional[int]:
 
 def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                  progress_path: Optional[str] = None) -> Dict[str, object]:
-    """Run one campaign; returns a schema-v6 bench run payload.
+    """Run one campaign; returns a schema-v7 bench run payload.
 
     The payload validates as an ``engine_tick`` run (``telemetry`` is the
     fleet-merged ``RunSummary``) and additionally carries the
-    ``campaign`` block (scenario-kind counts, spot-check results,
-    nearest-rank distributions, per-delay-regime
+    ``campaign`` block (scenario-kind counts, dispatch pools, spot-check
+    results, nearest-rank distributions, per-delay-regime
     ticks-to-first-decide tails) plus the dispatch observatory:
-    ``dispatch_timeline`` (one per-stage wall record per dispatch),
-    ``observatory`` (host-blocked vs device-busy vs compile wall
-    accounting), and ``clusters_per_sec``. ``wall_s`` is the end-to-end
-    campaign wall — sampling, lowering, stacking, the one-time AOT
-    compiles, execution, and folds; the per-dispatch stage walls sum to
-    it within ``schema.STAGE_SUM_TOLERANCE``. Oracle spot-check replay
+    ``dispatch_timeline`` (one per-stage wall record per dispatch, with
+    its pool identity), ``observatory`` (host-blocked vs device-busy vs
+    compile wall accounting plus the pipeline block), and
+    ``clusters_per_sec``. ``wall_s`` is the end-to-end campaign wall —
+    sampling, lowering, stacking, the per-pool AOT compiles, execution,
+    and folds; the per-dispatch stage walls sum to it within
+    ``schema.STAGE_SUM_TOLERANCE`` (under the pipeline, ``execute`` is
+    the *residual* fence wait — device time hidden behind host prep
+    appears in no stage, which is the point). Oracle spot-check replay
     runs first (fail-fast, before any device dispatch) and is outside
     ``wall_s`` (``spot_check_s``; ``total_s`` is the sum).
 
     ``trace_path`` exports the stages as Perfetto wall-clock spans;
     ``progress_path`` streams a JSONL heartbeat (``-`` for stderr).
     Both are I/O knobs, not campaign identity — everything derived from
-    ``cfg`` stays bit-identical with or without them.
+    ``cfg`` stays bit-identical with or without them, and
+    ``cfg.pipeline`` / ``cfg.fleet_shard`` change wall-clock fields
+    only.
     """
     import jax
 
     from rapid_tpu.engine import receiver as receiver_mod
+    from rapid_tpu.engine import sharding as sharding_mod
     from rapid_tpu.engine.fleet import (check_receiver_budget,
                                         fleet_aot_compile,
                                         lower_receiver_schedule,
@@ -403,8 +531,15 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     rx_settings = base if base.capacity == cfg.n \
         else base.with_(capacity=cfg.n)
     f = max(1, cfg.fleet_size)
-    dispatches = -(-cfg.clusters // f)
-    total = dispatches * f
+    # Sampled membership rounds up to whole fleets of f (the historical
+    # contract); the pooled plan below may split those members into more
+    # (smaller) dispatches than total/f.
+    total = -(-cfg.clusters // f) * f
+    fleet_mesh = (sharding_mod.fleet_axis_mesh(cfg.fleet_shard)
+                  if cfg.fleet_shard else None)
+    if cfg.compile_cache:
+        from rapid_tpu.engine.fleet import enable_compile_cache
+        enable_compile_cache()
 
     writer = TraceWriter() if trace_path else None
     progress = _ProgressWriter(progress_path)
@@ -440,190 +575,232 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     if rx_idx:
         check_receiver_budget(max(rx_settings.capacity, cfg.n), fr,
                               rx_settings)
+    # The dispatch plan: kind-homogeneous pools (shape signatures from
+    # the sampled schedules — no lowering needed), chunked to each
+    # pool's fleet size. Deterministic in the campaign seed, shared by
+    # the serial and pipelined drivers.
+    pools = _build_pools(scenarios, sh_idx, rx_idx, f)
+    plan = [(pool, chunk) for pool in pools
+            for chunk in _chunks(pool["members"], pool["fleet_size"])]
+
     lower_s: Dict[int, float] = {}
-    sh_members = {}
-    rx_members = {}
-    with wall_span(writer, "lower", {"members": total}):
-        for i in sh_idx:
-            t0 = time.perf_counter()
-            sh_members[i] = _lower_shared(cfg, settings, i, scenarios[i])
-            lower_s[i] = time.perf_counter() - t0
-        for i in rx_idx:
-            t0 = time.perf_counter()
-            rx_members[i] = lower_receiver_schedule(scenarios[i].schedule,
-                                                    rx_settings,
-                                                    fleet_size=fr)
-            lower_s[i] = time.perf_counter() - t0
-    boot_s = sum(sample_s.values()) + sum(lower_s.values())
-
-    # Campaign-global padding maxima: every dispatch of a mode shares
-    # one stacked shape, so the AOT executable compiles exactly once per
-    # mode and later dispatches are pure cache hits. The inert rows this
-    # buys are reported per dispatch as padding waste.
-    sh_w = max((m.faults.n_windows for m in sh_members.values()), default=0)
-    sh_inst = max((m.fallback.inst_epoch.shape[0]
-                   for m in sh_members.values()), default=0)
-    sh_pids = max((m.fallback.table_mask.shape[1]
-                   for m in sh_members.values()), default=0)
-    rx_w = max((m.faults.n_windows for m in rx_members.values()), default=0)
-    rx_d = max((m.faults.n_delay_rules for m in rx_members.values()),
-               default=0)
-
-    fs = min(f, len(sh_idx)) if sh_idx else 0
+    sh_members: Dict[int, object] = {}
+    rx_members: Dict[int, object] = {}
     timeline: List[Dict[str, object]] = []
-    compile_info: Dict[str, Optional[Dict[str, object]]] = {
-        "shared": None, "per_receiver": None}
-    executables: Dict[str, object] = {}
+    pool_compiles: List[Dict[str, object]] = []
+    executables: Dict[int, object] = {}
     summaries = []
     member_order: List[int] = []  # member index per summaries[] entry
     rx_dispatches = 0
     done = 0
+    in_flight: List[Dict[str, object]] = []  # FIFO, launch order
+    depth = PIPELINE_DEPTH if cfg.pipeline else 1
+    peak_in_flight = 0
+    launched = 0
 
-    def record_dispatch(mode, chunk, fleet_size, stages, compiled_now,
-                        padding):
-        nonlocal done
+    def _launch(pool, chunk):
+        """Lower/stack/compile this dispatch and launch it async.
+
+        With donated carries the executable call returns immediately
+        (JAX async dispatch); the fence lives in ``_retire``. The input
+        fleet reference is dropped here — donation consumes its buffers.
+        """
+        nonlocal launched, peak_in_flight
+        mode, pid = pool["mode"], pool["pool_id"]
+        fsize, shape, dims = pool["fleet_size"], pool["shape"], pool["dims"]
+        d = launched
+        launched += 1
+        # Trailing partial chunks pad by cycling their own members so
+        # every dispatch of a pool keeps that pool's program shape;
+        # padded summaries are dropped at fold.
+        padded = chunk + [chunk[i % len(chunk)]
+                          for i in range(fsize - len(chunk))]
+        with wall_span(writer, "lower",
+                       {"dispatch": d, "mode": mode, "pool": pid,
+                        "members": len(chunk)}):
+            for i in chunk:
+                t0 = time.perf_counter()
+                if mode == "shared":
+                    sh_members[i] = _lower_shared(cfg, settings, i,
+                                                  scenarios[i])
+                else:
+                    rx_members[i] = lower_receiver_schedule(
+                        scenarios[i].schedule, rx_settings,
+                        fleet_size=fsize)
+                lower_s[i] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with wall_span(writer, "stack",
+                       {"dispatch": d, "mode": mode, "pool": pid}):
+            if mode == "shared":
+                fleet = stack_members([sh_members[i] for i in padded],
+                                      n_windows=shape[0],
+                                      n_instances=shape[1],
+                                      n_pids=shape[2])
+            else:
+                fleet = stack_receiver_members(
+                    [rx_members[i] for i in padded],
+                    n_windows=shape[0], n_delay_rules=shape[1])
+            if fleet_mesh is not None:
+                fleet = sharding_mod.fleet_axis_put(fleet, fleet_mesh,
+                                                    fsize)
+        stack_s = time.perf_counter() - t0
+        # The lowered members are only inputs to the stack: drop them so
+        # a long campaign's live set is the in-flight dispatches, not
+        # every member ever lowered.
+        for i in chunk:
+            (sh_members if mode == "shared" else rx_members).pop(i)
+        compile_s = 0.0
+        compiled_now = pid not in executables
+        if compiled_now:
+            t0 = time.perf_counter()
+            with wall_span(writer, "compile",
+                           {"dispatch": d, "mode": mode, "pool": pid}):
+                if mode == "shared":
+                    exe, info = fleet_aot_compile(
+                        fleet, cfg.ticks, settings,
+                        fleet_mesh=fleet_mesh, donate=True)
+                else:
+                    exe, info = receiver_fleet_aot_compile(
+                        fleet, cfg.ticks, rx_settings,
+                        fleet_mesh=fleet_mesh, donate=True)
+                executables[pid] = exe
+                pool_compiles.append({"pool_id": pid, "mode": mode,
+                                      **info})
+            compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        if mode == "shared":
+            result = executables[pid](fleet.state, fleet.faults,
+                                      fleet.churn, fleet.fallback)
+        else:
+            result = executables[pid](fleet.state, fleet.faults)
+        launch_s = time.perf_counter() - t0
+        pad_dims = [dims[i] for i in padded]
+        if mode == "shared":
+            padding = {
+                "window_rows": fsize * shape[0] - sum(
+                    dd[0] for dd in pad_dims),
+                "fallback_instances": fsize * shape[1] - sum(
+                    dd[1] for dd in pad_dims),
+                "fallback_pids": fsize * shape[2] - sum(
+                    dd[2] for dd in pad_dims),
+                "delay_rules": 0}
+        else:
+            padding = {
+                "window_rows": fsize * shape[0] - sum(
+                    dd[0] for dd in pad_dims),
+                "fallback_instances": 0, "fallback_pids": 0,
+                "delay_rules": fsize * shape[1] - sum(
+                    dd[1] for dd in pad_dims)}
+        # The fleet reference rides along until the fence: deleting the
+        # not-donatable input buffers while the computation is in flight
+        # blocks the host until it finishes (a hidden fence that would
+        # serialize the pipeline and hide device time from every stage).
+        in_flight.append({
+            "index": d, "pool": pool, "chunk": chunk, "result": result,
+            "fleet": fleet,
+            "compiled_now": compiled_now, "padding": padding,
+            "stages": {"sample": sum(sample_s[i] for i in chunk),
+                       "lower": sum(lower_s[i] for i in chunk),
+                       "stack": stack_s, "compile": compile_s},
+            "launch_s": launch_s})
+        peak_in_flight = max(peak_in_flight, len(in_flight))
+
+    def _retire(entry):
+        """Fence the oldest in-flight dispatch, fold it, and record it.
+
+        Retirement order is launch order (FIFO), so the summaries /
+        member order / timeline are identical to the serial driver's —
+        only the wall-clock fields differ.
+        """
+        nonlocal rx_dispatches, done
+        pool, chunk = entry["pool"], entry["chunk"]
+        mode, pid, d = pool["mode"], pool["pool_id"], entry["index"]
+        t0 = time.perf_counter()
+        with wall_span(writer, "execute",
+                       {"dispatch": d, "mode": mode, "pool": pid,
+                        "fleet_size": pool["fleet_size"]}):
+            jax.block_until_ready(entry["result"])
+        wait_s = time.perf_counter() - t0
+        # Computation done: dropping the input reference is now free, and
+        # the donated buffers it pinned are released before the fold.
+        entry.pop("fleet")
+        finals, logs = entry["result"]
+        t0 = time.perf_counter()
+        with wall_span(writer, "fold",
+                       {"dispatch": d, "mode": mode, "pool": pid}):
+            if mode == "shared":
+                summaries.extend(fleet_summaries(logs)[:len(chunk)])
+            else:
+                rx_dispatches += 1
+                for j in range(len(chunk)):
+                    mrs = jax.tree_util.tree_map(lambda x, j=j: x[j],
+                                                 finals)
+                    mlog = jax.tree_util.tree_map(lambda x, j=j: x[j],
+                                                  logs)
+                    # A nonzero envelope flag would void the
+                    # device-exact claim for this member; eligibility
+                    # keeps schedules inside the envelope, so this
+                    # raising means an engine bug.
+                    receiver_mod.check_flags(mrs.flags)
+                    run = receiver_mod.receiver_run_payload(
+                        mrs, mlog, cfg.n, cfg.ticks)
+                    summaries.append(summarize(run.metrics()))
+            member_order.extend(chunk)
+            # The memory watermark walks every live buffer in the
+            # process — real host work, so it bills to the fold stage
+            # rather than hiding as unaccounted glue between stages.
+            memory = {"live_buffer_bytes": _live_buffer_bytes(jax),
+                      "device_peak_bytes": _device_peak_bytes(jax)}
+        fold_stage_s = time.perf_counter() - t0
         done += len(chunk)
         kinds: Dict[str, int] = {}
         for i in chunk:
-            k = scenarios[i].kind
-            kinds[k] = kinds.get(k, 0) + 1
+            kinds[scenarios[i].kind] = kinds.get(scenarios[i].kind, 0) + 1
+        stages = dict(entry["stages"])
+        stages["execute"] = entry["launch_s"] + wait_s
+        stages["fold"] = fold_stage_s
         wall = sum(stages.values())
         rec = {
             "index": len(timeline),
             "mode": mode,
+            "pool_id": pid,
+            "pool_shape": _pool_shape_dict(mode, pool["shape"]),
             "members": len(chunk),
-            "pad_members": fleet_size - len(chunk),
-            "fleet_size": fleet_size,
+            "pad_members": pool["fleet_size"] - len(chunk),
+            "fleet_size": pool["fleet_size"],
             "kinds": dict(sorted(kinds.items())),
-            "compiled": compiled_now,
+            "compiled": entry["compiled_now"],
             "stages": {k: round(v, 6) for k, v in stages.items()},
             "wall_s": round(wall, 6),
             "clusters_per_sec": _rate(len(chunk), wall),
             "host_blocked_frac": (
                 (wall - stages["execute"]) / wall
                 if wall >= MIN_MEASURABLE_WALL_S else None),
-            "padding": padding,
-            "memory": {"live_buffer_bytes": _live_buffer_bytes(jax),
-                       "device_peak_bytes": _device_peak_bytes(jax)},
+            "padding": entry["padding"],
+            "memory": memory,
         }
         timeline.append(rec)
         progress.emit({"record": "dispatch", "index": rec["index"],
-                       "mode": mode, "clusters_done": done,
+                       "mode": mode, "pool_id": pid,
+                       "pool_shape": rec["pool_shape"],
+                       "in_flight_dispatches": len(in_flight),
+                       "clusters_done": done,
                        "clusters_total": total, "stages": rec["stages"],
                        "spot_failures": spot["failed"]})
         return rec
 
-    for chunk in _chunks(sh_idx, fs) if fs else []:
-        # Pad a trailing partial chunk by cycling its own members so
-        # every shared dispatch keeps one batched program shape; padded
-        # summaries are dropped below.
-        padded = chunk + [chunk[i % len(chunk)]
-                          for i in range(fs - len(chunk))]
-        d = len(timeline)
-        t0 = time.perf_counter()
-        with wall_span(writer, "stack", {"dispatch": d, "mode": "shared"}):
-            fleet = stack_members([sh_members[i] for i in padded],
-                                  n_windows=sh_w, n_instances=sh_inst,
-                                  n_pids=sh_pids)
-        stack_s = time.perf_counter() - t0
-        compile_s = 0.0
-        compiled_now = "shared" not in executables
-        if compiled_now:
-            t0 = time.perf_counter()
-            with wall_span(writer, "compile",
-                           {"dispatch": d, "mode": "shared"}):
-                executables["shared"], compile_info["shared"] = \
-                    fleet_aot_compile(fleet, cfg.ticks, settings)
-            compile_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        with wall_span(writer, "execute",
-                       {"dispatch": d, "mode": "shared",
-                        "fleet_size": fs}):
-            finals, logs = executables["shared"](fleet.state, fleet.faults,
-                                                 fleet.churn, fleet.fallback)
-            jax.block_until_ready((finals, logs))
-        execute_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        with wall_span(writer, "fold", {"dispatch": d, "mode": "shared"}):
-            summaries += fleet_summaries(logs)[:len(chunk)]
-            member_order += chunk
-        fold_stage_s = time.perf_counter() - t0
-        record_dispatch(
-            "shared", chunk, fs,
-            {"sample": sum(sample_s[i] for i in chunk),
-             "lower": sum(lower_s[i] for i in chunk),
-             "stack": stack_s, "compile": compile_s,
-             "execute": execute_s, "fold": fold_stage_s},
-            compiled_now,
-            {"window_rows": fs * sh_w - sum(
-                sh_members[i].faults.n_windows for i in padded),
-             "fallback_instances": fs * sh_inst - sum(
-                 sh_members[i].fallback.inst_epoch.shape[0]
-                 for i in padded),
-             "fallback_pids": fs * sh_pids - sum(
-                 sh_members[i].fallback.table_mask.shape[1]
-                 for i in padded),
-             "delay_rules": 0})
+    # The driver: launch each planned dispatch, retiring the oldest
+    # whenever the in-flight queue is full. depth == 1 is the serial
+    # driver (fence right after launch); depth == 2 double-buffers.
+    for pool, chunk in plan:
+        _launch(pool, chunk)
+        while len(in_flight) >= depth:
+            _retire(in_flight.pop(0))
+    while in_flight:
+        _retire(in_flight.pop(0))
 
-    for chunk in _chunks(rx_idx, fr) if fr else []:
-        padded = chunk + [chunk[i % len(chunk)]
-                          for i in range(fr - len(chunk))]
-        d = len(timeline)
-        t0 = time.perf_counter()
-        with wall_span(writer, "stack",
-                       {"dispatch": d, "mode": "per_receiver"}):
-            fleet = stack_receiver_members([rx_members[i] for i in padded],
-                                           n_windows=rx_w,
-                                           n_delay_rules=rx_d)
-        stack_s = time.perf_counter() - t0
-        compile_s = 0.0
-        compiled_now = "per_receiver" not in executables
-        if compiled_now:
-            t0 = time.perf_counter()
-            with wall_span(writer, "compile",
-                           {"dispatch": d, "mode": "per_receiver"}):
-                executables["per_receiver"], \
-                    compile_info["per_receiver"] = \
-                    receiver_fleet_aot_compile(fleet, cfg.ticks,
-                                               rx_settings)
-            compile_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        with wall_span(writer, "execute",
-                       {"dispatch": d, "mode": "per_receiver",
-                        "fleet_size": fr}):
-            finals, logs = executables["per_receiver"](fleet.state,
-                                                       fleet.faults)
-            jax.block_until_ready((finals, logs))
-        execute_s = time.perf_counter() - t0
-        rx_dispatches += 1
-        t0 = time.perf_counter()
-        with wall_span(writer, "fold",
-                       {"dispatch": d, "mode": "per_receiver"}):
-            for j in range(len(chunk)):
-                mrs = jax.tree_util.tree_map(lambda x, j=j: x[j], finals)
-                mlog = jax.tree_util.tree_map(lambda x, j=j: x[j], logs)
-                # A nonzero envelope flag would void the device-exact
-                # claim for this member; eligibility keeps schedules
-                # inside the envelope, so this raising means an engine
-                # bug.
-                receiver_mod.check_flags(mrs.flags)
-                run = receiver_mod.receiver_run_payload(mrs, mlog, cfg.n,
-                                                        cfg.ticks)
-                summaries.append(summarize(run.metrics()))
-            member_order += chunk
-        fold_stage_s = time.perf_counter() - t0
-        record_dispatch(
-            "per_receiver", chunk, fr,
-            {"sample": sum(sample_s[i] for i in chunk),
-             "lower": sum(lower_s[i] for i in chunk),
-             "stack": stack_s, "compile": compile_s,
-             "execute": execute_s, "fold": fold_stage_s},
-            compiled_now,
-            {"window_rows": fr * rx_w - sum(
-                rx_members[i].faults.n_windows for i in padded),
-             "fallback_instances": 0, "fallback_pids": 0,
-             "delay_rules": fr * rx_d - sum(
-                 rx_members[i].faults.n_delay_rules for i in padded)})
+    boot_s = sum(sample_s.values()) + sum(lower_s.values())
+    dispatches = len(plan)
 
     # Spot checks ran inside the t_begin..now window but are host referee
     # work, not campaign pipeline — subtract them so ``wall_s`` keeps its
@@ -660,6 +837,48 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     progress.close()
     if writer is not None:
         writer.write(trace_path)
+
+    def _agg_compiles(mode):
+        """Sum per-pool AOT compile costs for one mode; None when no
+        pool of that mode compiled (mirrors the old one-executable
+        ``compile_info[mode]`` shape for schema continuity)."""
+        rows = [p for p in pool_compiles if p["mode"] == mode]
+        if not rows:
+            return None
+        agg: Dict[str, object] = {}
+        for key in rows[0]:
+            if key in ("pool_id", "mode"):
+                continue
+            vals = [r[key] for r in rows]
+            if any(v is None for v in vals):
+                agg[key] = None
+            elif all(isinstance(v, (int, float)) for v in vals):
+                agg[key] = sum(vals)
+            else:
+                agg[key] = vals[0]
+        return agg
+
+    compile_info: Dict[str, object] = {
+        "shared": _agg_compiles("shared"),
+        "per_receiver": _agg_compiles("per_receiver"),
+        "pools": pool_compiles,
+    }
+
+    pool_blocks = []
+    for pool in pools:
+        pkinds: Dict[str, int] = {}
+        for i in pool["members"]:
+            k = scenarios[i].kind
+            pkinds[k] = pkinds.get(k, 0) + 1
+        pool_blocks.append({
+            "pool_id": pool["pool_id"],
+            "mode": pool["mode"],
+            "members": len(pool["members"]),
+            "dispatches": -(-len(pool["members"]) // pool["fleet_size"]),
+            "fleet_size": pool["fleet_size"],
+            "kinds": dict(sorted(pkinds.items())),
+            "shape": _pool_shape_dict(pool["mode"], pool["shape"]),
+        })
 
     rx_kinds: Dict[str, int] = {}
     for i in rx_idx:
@@ -722,6 +941,11 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             "overlap_headroom_s": min(host_blocked_s, device_busy_s),
             "min_measurable_wall_s": MIN_MEASURABLE_WALL_S,
             "compile": compile_info,
+            "pipeline": {
+                "enabled": cfg.pipeline,
+                "max_in_flight": depth,
+                "peak_in_flight": peak_in_flight,
+            },
         },
         "campaign": {
             "seed": cfg.seed,
@@ -729,6 +953,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             "fleet_size": f,
             "dispatches": dispatches,
             "scenario_kinds": dict(sorted(kinds.items())),
+            "pools": pool_blocks,
             "per_receiver": per_receiver,
             "spot_checks": spot,
             "distributions": dists,
@@ -798,6 +1023,27 @@ def main(argv=None) -> int:
                         help="stream a JSONL heartbeat line per completed "
                              "dispatch (and per spot check) to FILE; '-' "
                              "streams to stderr")
+    parser.add_argument("--pipeline", dest="pipeline", action="store_true",
+                        default=True,
+                        help="double-buffer dispatches: lower/stack "
+                             "dispatch F+1 on the host while F executes "
+                             "on device (default)")
+    parser.add_argument("--no-pipeline", dest="pipeline",
+                        action="store_false",
+                        help="serial driver: fence each dispatch before "
+                             "preparing the next (the pre-pipeline "
+                             "behaviour; payloads are bit-identical to "
+                             "--pipeline in all non-wall fields)")
+    parser.add_argument("--no-compile-cache", dest="compile_cache",
+                        action="store_false",
+                        help="skip the on-disk XLA compilation cache "
+                             "(RAPID_TPU_COMPILE_CACHE overrides the "
+                             "default ~/.cache/rapid_tpu/xla directory)")
+    parser.add_argument("--fleet-shard", type=int, default=None,
+                        metavar="D",
+                        help="shard each dispatch's fleet axis over D "
+                             "devices (P('fleet'), no collectives); "
+                             "errors if fewer devices exist")
     args = parser.parse_args(argv)
 
     cfg = CampaignConfig(clusters=args.clusters, n=args.n, ticks=args.ticks,
@@ -806,7 +1052,10 @@ def main(argv=None) -> int:
                          spot_checks=args.spot_checks,
                          per_receiver=not args.no_per_receiver,
                          max_spot_failures=args.max_spot_failures,
-                         artifact_dir=args.spot_artifacts)
+                         artifact_dir=args.spot_artifacts,
+                         pipeline=args.pipeline,
+                         fleet_shard=args.fleet_shard,
+                         compile_cache=args.compile_cache)
     payload = run_campaign(cfg, trace_path=args.trace,
                            progress_path=args.progress)
     if args.out:

@@ -58,6 +58,7 @@ kind at lowering time:
 """
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
@@ -68,7 +69,8 @@ from rapid_tpu.engine import paxos as paxos_mod
 from rapid_tpu.engine.state import (EngineFaults, EngineState, init_state,
                                     link_faults, pad_delay_rules,
                                     pad_link_windows)
-from rapid_tpu.engine.step import (_fleet_simulate, fleet_trace_count,
+from rapid_tpu.engine.step import (_fleet_simulate, _fleet_simulate_donated,
+                                   fleet_trace_count,
                                    reset_fleet_trace_count)
 from rapid_tpu.faults import AdversarySchedule, validate_schedule
 from rapid_tpu.settings import Settings
@@ -78,6 +80,8 @@ __all__ = [
     "ReceiverBudgetError",
     "ReceiverMember",
     "check_receiver_budget",
+    "clear_boot_caches",
+    "enable_compile_cache",
     "fleet_aot_compile",
     "fleet_simulate",
     "fleet_trace_count",
@@ -107,15 +111,94 @@ class FleetMember(NamedTuple):
     fallback: paxos_mod.FallbackSchedule
 
 
-def _default_identities(n: int):
-    """The differential-harness identity universe for an n-slot scenario."""
+@functools.lru_cache(maxsize=None)
+def _default_identities_cached(n: int) -> Tuple[Tuple[int, ...], int]:
     from rapid_tpu.engine.diff import default_endpoints, default_node_ids
     from rapid_tpu.oracle.membership_view import id_fingerprint, uid_of
 
-    uids = [uid_of(e) for e in default_endpoints(n)]
+    uids = tuple(uid_of(e) for e in default_endpoints(n))
     id_fp_sum = sum(id_fingerprint(nid)
                     for nid in default_node_ids(n)) & ((1 << 64) - 1)
     return uids, id_fp_sum
+
+
+def _default_identities(n: int):
+    """The differential-harness identity universe for an n-slot scenario.
+
+    Memoized per N: a campaign lowers hundreds of members of the same
+    size, and the uid/fingerprint hash loop is pure host work that never
+    changes for a given universe.
+    """
+    uids, id_fp_sum = _default_identities_cached(n)
+    return list(uids), id_fp_sum
+
+
+#: Booted default-universe EngineStates keyed by
+#: (n, n_uids, id_fp_sum, settings). Members differ only in their fault
+#: scripts and dormant-slot id fingerprints, so the expensive boot —
+#: host lexsort ring permutations, device build_topology/ring0_positions,
+#: LUT materialization — is computed once per shape and shared;
+#: per-member ``id_fps`` are patched in with a cheap ``_replace``. Safe
+#: because lowered states are read-only inputs to ``jnp.stack`` (every
+#: dispatch stacks fresh buffers; donation only ever consumes those).
+_BOOT_CACHE: Dict[Tuple, EngineState] = {}
+
+#: Booted default-universe ReceiverState templates keyed by
+#: (n, id_fp_sum, settings). The only seed-dependent leaf of
+#: ``init_receiver_state`` is the jitter ``delay_table``
+#: (``build_delay_table(seed, ...)``); everything else — the base boot
+#: plus the [C, C(, K)] per-slot broadcasts — is identical across
+#: members, so the template is built once with seed 0 and each member
+#: replaces just its delay table.
+_RX_BOOT_CACHE: Dict[Tuple, object] = {}
+
+
+def clear_boot_caches() -> None:
+    """Drop the memoized boot states (tests; long multi-config runs)."""
+    _BOOT_CACHE.clear()
+    _RX_BOOT_CACHE.clear()
+    _default_identities_cached.cache_clear()
+
+
+#: Resolved persistent-cache directory once enabled (None = not enabled).
+_COMPILE_CACHE_DIR: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> str:
+    """Persist AOT executables to an on-disk XLA compilation cache.
+
+    The per-pool executable cache dedupes compiles *within* one
+    campaign; this extends it *across* processes: XLA serializes each
+    compiled program keyed by its HLO fingerprint, so a re-run of the
+    same campaign (or any campaign whose pools hit the same program
+    shapes) loads executables from disk instead of re-running LLVM.
+    Identical programs by construction — only compile wall changes.
+
+    Resolution order: explicit ``cache_dir`` argument, then the
+    ``RAPID_TPU_COMPILE_CACHE`` environment variable, then
+    ``~/.cache/rapid_tpu/xla``. Idempotent; returns the directory in
+    effect (the first enabled directory wins, matching XLA's own
+    process-global cache config).
+
+    Call before the process's first compilation: XLA binds the cache
+    when the first program compiles, and enabling the directory after
+    that point is silently a no-op (``bench.py`` enables it at the top
+    of ``main`` for exactly this reason).
+    """
+    global _COMPILE_CACHE_DIR
+    if _COMPILE_CACHE_DIR is not None:
+        return _COMPILE_CACHE_DIR
+    import os
+
+    import jax
+    cache_dir = (cache_dir
+                 or os.environ.get("RAPID_TPU_COMPILE_CACHE")
+                 or os.path.join(os.path.expanduser("~"), ".cache",
+                                 "rapid_tpu", "xla"))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    _COMPILE_CACHE_DIR = cache_dir
+    return cache_dir
 
 
 def _compile_proposes(schedule: AdversarySchedule, uids_np: np.ndarray,
@@ -166,6 +249,7 @@ def lower_schedule(schedule: AdversarySchedule, settings: Settings, *,
         raise ValueError("shared-state members do not support delay rules; "
                          "lower with lower_receiver_schedule instead")
     n = schedule.n
+    default_universe = uids is None
     if uids is None:
         uids, default_sum = _default_identities(n)
         if id_fp_sum is None:
@@ -183,7 +267,19 @@ def lower_schedule(schedule: AdversarySchedule, settings: Settings, *,
 
         uids = list(uids) + [hashing.hash64(i, seed=0x636170)
                              for i in range(len(id_fps) - len(uids))]
-    state = init_state(uids, id_fp_sum, eff, id_fps=id_fps)
+    if default_universe:
+        # Memoized boot: the uid universe is a pure function of
+        # (n, len(uids)) here, so the booted state is shared across the
+        # fleet and only the dormant-slot id fingerprints differ.
+        key = (n, len(uids), id_fp_sum, eff)
+        state = _BOOT_CACHE.get(key)
+        if state is None:
+            state = init_state(uids, id_fp_sum, eff)
+            _BOOT_CACHE[key] = state
+        if id_fps is not None:
+            state = _patch_id_fps(state, id_fps, c)
+    else:
+        state = init_state(uids, id_fp_sum, eff, id_fps=id_fps)
     uids_np = _uids_np_from_state(state)
 
     crash = np.full(c, np.iinfo(np.int32).max, np.int64)
@@ -197,6 +293,24 @@ def lower_schedule(schedule: AdversarySchedule, settings: Settings, *,
                          "(treedefs must match across the fleet axis)")
     return FleetMember(state=state, faults=faults, churn=churn,
                        fallback=fallback)
+
+
+def _patch_id_fps(state: EngineState, id_fps, c: int) -> EngineState:
+    """Swap a member's dormant-slot id fingerprints into a cached boot
+    state — bit-identical to ``init_state(..., id_fps=...)``, which only
+    ever feeds ``id_fps`` (zero-padded to capacity) into the
+    ``idfp_hi/lo`` limbs."""
+    import jax.numpy as jnp
+
+    from rapid_tpu import hashing
+
+    id_fps_np = np.asarray(id_fps, dtype=np.uint64)
+    if len(id_fps_np) < c:
+        id_fps_np = np.concatenate(
+            [id_fps_np, np.zeros(c - len(id_fps_np), np.uint64)])
+    ifp_hi, ifp_lo = hashing.np_to_limbs(id_fps_np)
+    return state._replace(idfp_hi=jnp.asarray(ifp_hi),
+                          idfp_lo=jnp.asarray(ifp_lo))
 
 
 def _uids_np_from_state(state: EngineState) -> np.ndarray:
@@ -303,7 +417,7 @@ def stack_members(members: Sequence[FleetMember], *,
 
 
 def fleet_simulate(fleet: FleetMember, n_ticks: int,
-                   settings: Settings, mesh=None) -> tuple:
+                   settings: Settings, mesh=None, fleet_mesh=None) -> tuple:
     """Run every fleet member ``n_ticks`` ticks in one jitted dispatch.
 
     ``fleet`` is the batched pytree from ``stack_members``. Returns
@@ -315,10 +429,14 @@ def fleet_simulate(fleet: FleetMember, n_ticks: int,
     ``mesh`` (static) shards every member's slot axis over the device
     mesh while the fleet axis stays replicated (``P(None, 'slots')`` on
     ``[F, C]`` leaves) — the vmapped campaign and the single-member run
-    produce bit-identical results either way.
+    produce bit-identical results either way. ``fleet_mesh`` (static,
+    mutually exclusive with ``mesh``) instead shards the *fleet* axis as
+    ``P('fleet')``: whole members per device, no collectives, also
+    bit-identical.
     """
     return _fleet_simulate(fleet.state, fleet.faults, fleet.churn,
-                           fleet.fallback, int(n_ticks), settings, mesh)
+                           fleet.fallback, int(n_ticks), settings, mesh,
+                           fleet_mesh)
 
 
 def _aot_info(lowered, lower_s: float) -> Tuple[object, Dict[str, object]]:
@@ -336,7 +454,8 @@ def _aot_info(lowered, lower_s: float) -> Tuple[object, Dict[str, object]]:
 
 
 def fleet_aot_compile(fleet: FleetMember, n_ticks: int, settings: Settings,
-                      mesh=None) -> Tuple[object, Dict[str, object]]:
+                      mesh=None, fleet_mesh=None,
+                      donate: bool = False) -> Tuple[object, Dict[str, object]]:
     """AOT-compile the shared-state fleet program for ``fleet``'s shape.
 
     Returns ``(compiled, info)``: ``compiled(state, faults, churn,
@@ -347,11 +466,17 @@ def fleet_aot_compile(fleet: FleetMember, n_ticks: int, settings: Settings,
     measurement, not an inference from trace counters — every dispatch
     of the same stacked shape reuses the executable with zero compile
     wall.
+
+    ``donate=True`` compiles the single-shot variant whose input buffers
+    are consumed by the outputs (the pipelined campaign driver's choice:
+    each stacked fleet is executed exactly once). ``fleet_mesh`` shards
+    the fleet axis — see ``fleet_simulate``.
     """
+    fn = _fleet_simulate_donated if donate else _fleet_simulate
     t0 = time.perf_counter()
-    lowered = _fleet_simulate.lower(fleet.state, fleet.faults, fleet.churn,
-                                    fleet.fallback, int(n_ticks), settings,
-                                    mesh)
+    lowered = fn.lower(fleet.state, fleet.faults, fleet.churn,
+                       fleet.fallback, int(n_ticks), settings, mesh,
+                       fleet_mesh)
     return _aot_info(lowered, time.perf_counter() - t0)
 
 
@@ -437,13 +562,32 @@ def lower_receiver_schedule(schedule: AdversarySchedule,
     c = max(settings.capacity, n)
     eff = settings if settings.capacity == c else settings.with_(capacity=c)
     check_receiver_budget(c, fleet_size, eff)
+    default_universe = uids is None
     if uids is None:
         uids, default_sum = _default_identities(n)
         if id_fp_sum is None:
             id_fp_sum = default_sum
     elif id_fp_sum is None:
         id_fp_sum = 0
-    state = init_receiver_state(uids, id_fp_sum, eff, seed=schedule.seed)
+    if default_universe:
+        # Memoized boot template: everything but the seeded jitter
+        # delay_table is schedule-independent, and booting the quadratic
+        # receiver state (base boot + [C, C(, K)] broadcasts) dominated
+        # per-member lowering wall before this cache.
+        from rapid_tpu.engine.receiver import N_DRAWS
+        from rapid_tpu.engine.paxos import build_delay_table
+
+        key = (n, id_fp_sum, eff)
+        template = _RX_BOOT_CACHE.get(key)
+        if template is None:
+            template = init_receiver_state(uids, id_fp_sum, eff, seed=0)
+            _RX_BOOT_CACHE[key] = template
+        import jax.numpy as jnp
+
+        state = template._replace(delay_table=jnp.asarray(
+            build_delay_table(schedule.seed, c, N_DRAWS, eff)))
+    else:
+        state = init_receiver_state(uids, id_fp_sum, eff, seed=schedule.seed)
     crash = np.full(c, np.iinfo(np.int32).max, np.int64)
     crash[:n] = schedule.crash_tick_array()
     faults = link_faults(crash.tolist(), schedule.windows, c,
@@ -488,26 +632,31 @@ def stack_receiver_members(members: Sequence[ReceiverMember], *,
 
 
 def receiver_fleet_simulate(fleet: ReceiverMember, n_ticks: int,
-                            settings: Settings) -> tuple:
+                            settings: Settings, fleet_mesh=None) -> tuple:
     """Run a stacked per-receiver fleet in one jitted dispatch.
 
     Returns ``(final_states, logs)`` with a leading fleet axis on every
     leaf, like ``fleet_simulate``. The tick body traces once regardless
-    of F."""
+    of F. ``fleet_mesh`` optionally shards the member axis."""
     from rapid_tpu.engine.receiver import receiver_fleet_simulate as _run
 
-    return _run(fleet.state, fleet.faults, int(n_ticks), settings)
+    return _run(fleet.state, fleet.faults, int(n_ticks), settings,
+                fleet_mesh)
 
 
 def receiver_fleet_aot_compile(fleet: ReceiverMember, n_ticks: int,
-                               settings: Settings
+                               settings: Settings, fleet_mesh=None,
+                               donate: bool = False
                                ) -> Tuple[object, Dict[str, object]]:
     """AOT-compile the per-receiver fleet program (the
     ``fleet_aot_compile`` analogue): ``compiled(state, faults)`` plus
-    the lower/compile/memory info record."""
-    from rapid_tpu.engine.receiver import _fleet_simulate as _rx_simulate
+    the lower/compile/memory info record. ``donate``/``fleet_mesh`` as
+    in ``fleet_aot_compile``."""
+    from rapid_tpu.engine import receiver as receiver_mod
 
+    fn = (receiver_mod._fleet_simulate_donated if donate
+          else receiver_mod._fleet_simulate)
     t0 = time.perf_counter()
-    lowered = _rx_simulate.lower(fleet.state, fleet.faults, int(n_ticks),
-                                 settings)
+    lowered = fn.lower(fleet.state, fleet.faults, int(n_ticks),
+                       settings, fleet_mesh)
     return _aot_info(lowered, time.perf_counter() - t0)

@@ -84,6 +84,7 @@ from jax import lax
 
 from rapid_tpu import hashing
 from rapid_tpu.engine import cut, monitor
+from rapid_tpu.engine import sharding as sharding_mod
 from rapid_tpu.engine.state import (
     I32_MAX, EngineFaults, ReceiverState, ReceiverStepLog, config_id_limbs)
 from rapid_tpu.settings import Settings
@@ -921,17 +922,43 @@ def receiver_simulate(rs: ReceiverState, faults: EngineFaults,
     return _simulate(rs, faults, n_ticks, settings)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _fleet_simulate(rs, faults, n_ticks: int, settings: Settings):
-    return jax.vmap(lambda s, f: _simulate(s, f, n_ticks, settings))(
-        rs, faults)
+def _fleet_body(rs, faults, n_ticks: int, settings: Settings,
+                fleet_mesh=None):
+    # ``fleet_mesh`` (static) partitions the vmapped member axis as
+    # P("fleet") — each device owns whole members, no collectives. The
+    # default None path traces a byte-identical jaxpr (no constraint
+    # eqns), mirroring step.fleet_body's contract.
+    if fleet_mesh is not None:
+        f = rs.member.shape[0]
+        rs = sharding_mod.fleet_axis_constrain_tree(rs, fleet_mesh, f)
+        faults = sharding_mod.fleet_axis_constrain_tree(
+            faults, fleet_mesh, f)
+    finals, logs = jax.vmap(
+        lambda s, f_: _simulate(s, f_, n_ticks, settings))(rs, faults)
+    if fleet_mesh is not None:
+        finals = sharding_mod.fleet_axis_constrain_tree(
+            finals, fleet_mesh, f)
+        logs = sharding_mod.fleet_axis_constrain_tree(logs, fleet_mesh, f)
+    return finals, logs
+
+
+_fleet_simulate = functools.partial(
+    jax.jit, static_argnums=(2, 3, 4))(_fleet_body)
+
+# Donated twin for single-shot campaign dispatches: input buffers are
+# reused for outputs, halving the per-dispatch working set (the O(C^2)
+# receiver planes dominate fleet memory).
+_fleet_simulate_donated = functools.partial(
+    jax.jit, static_argnums=(2, 3, 4), donate_argnums=(0, 1))(_fleet_body)
 
 
 def receiver_fleet_simulate(stacked_rs, stacked_faults, n_ticks: int,
-                            settings: Settings):
+                            settings: Settings, fleet_mesh=None):
     """vmap the per-receiver scan over a leading fleet axis (the tick body
-    traces once regardless of F, like the shared fleet path)."""
-    return _fleet_simulate(stacked_rs, stacked_faults, n_ticks, settings)
+    traces once regardless of F, like the shared fleet path).
+    ``fleet_mesh`` optionally shards the member axis over the devices."""
+    return _fleet_simulate(stacked_rs, stacked_faults, n_ticks, settings,
+                           fleet_mesh)
 
 
 # --- host-side extraction ------------------------------------------------

@@ -213,6 +213,86 @@ def fleet_shard_put(tree, mesh, capacity: int):
         tree)
 
 
+#: The fleet-parallel mesh axis name: the vmapped member dimension.
+FLEET_AXIS = "fleet"
+
+
+def fleet_axis_mesh(n_devices: Optional[int] = None, devices=None):
+    """A 1-D mesh over ``devices``, axis name ``FLEET_AXIS``.
+
+    The data-parallel dual of ``slot_mesh``: instead of splitting one
+    cluster's slot universe across devices, each device owns whole fleet
+    members. Campaign dispatches are embarrassingly parallel along the
+    fleet axis — no collectives at all — so this is the layout that
+    scales clusters/sec with device count. Same trim-and-error contract
+    as ``slot_mesh``.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices for the fleet mesh, have "
+                f"{len(devices)} — force more with XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n_devices} "
+                f"before importing jax")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (FLEET_AXIS,))
+
+
+def fleet_axis_spec_for(shape: Sequence[int], fleet_size: int, mesh):
+    """The ``PartitionSpec`` for one fleet-stacked leaf: shard axis 0
+    when it is the fleet axis, replicate everything else.
+
+    Fleet-stacked pytrees carry ``F`` as the leading dimension of every
+    leaf (``[F]`` scalars-per-member through ``[F, C, C, K]`` observer
+    tables). Sharding that one axis as ``P("fleet")`` splits members
+    across devices with zero cross-device traffic. The divisibility
+    guard replicates when ``F`` does not divide the mesh (uneven member
+    padding would force reshards), which also keeps static-shaped
+    constants without a fleet axis replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = int(mesh.shape[FLEET_AXIS])
+    if shape and shape[0] == fleet_size and fleet_size % n_dev == 0:
+        return P(FLEET_AXIS)
+    return P()
+
+
+def fleet_axis_constrain_tree(tree, mesh, fleet_size: int):
+    """``with_sharding_constraint`` every leaf under
+    ``fleet_axis_spec_for``; identity when ``mesh is None`` (the
+    default path traces a byte-identical jaxpr — no constraint eqns)."""
+    if mesh is None:
+        return tree
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, fleet_axis_spec_for(
+                jax.numpy.shape(x), fleet_size, mesh))),
+        tree)
+
+
+def fleet_axis_put(tree, mesh, fleet_size: int):
+    """``device_put`` a fleet-stacked pytree with committed
+    ``P("fleet")`` shardings so member shards land on their owning
+    device before dispatch (GSPMD then keeps them there)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, fleet_axis_spec_for(
+                jax.numpy.shape(x), fleet_size, mesh))),
+        tree)
+
+
 def state_shardings(state, mesh):
     """Per-leaf ``NamedSharding`` pytree for an ``EngineState`` (or any
     slot-universe pytree) — usable as jit ``in_shardings``/

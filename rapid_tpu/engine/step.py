@@ -465,7 +465,7 @@ def reset_fleet_trace_count() -> None:
 
 
 def fleet_body(states, faults, churn, fallback, n_ticks: int,
-               settings: Settings, mesh=None):
+               settings: Settings, mesh=None, fleet_mesh=None):
     """The un-jitted fleet computation: ``vmap(scan(step))``.
 
     Every argument is a pytree whose leaves carry a leading fleet axis
@@ -480,9 +480,29 @@ def fleet_body(states, faults, churn, fallback, n_ticks: int,
     axis is partitioned while the fleet axis stays replicated — the
     batched constraint lowers to ``P(None, 'slots')`` on ``[F, C]``
     leaves, so a vmapped campaign shards exactly like a single member.
+
+    ``fleet_mesh`` (static) is the orthogonal routing: the *fleet* axis
+    is partitioned as ``P("fleet")`` while each member stays whole on
+    its owning device — embarrassingly parallel, no collectives. The
+    two routings are mutually exclusive; both ``None`` traces a
+    byte-identical jaxpr to the unsharded engine.
     """
     global _FLEET_TRACE_COUNT
     _FLEET_TRACE_COUNT += 1
+    if mesh is not None and fleet_mesh is not None:
+        raise ValueError(
+            "mesh (slot-axis sharding) and fleet_mesh (fleet-axis "
+            "sharding) are mutually exclusive routings")
+    if fleet_mesh is not None:
+        f = states.member.shape[0]
+        states = sharding_mod.fleet_axis_constrain_tree(
+            states, fleet_mesh, f)
+        faults = sharding_mod.fleet_axis_constrain_tree(
+            faults, fleet_mesh, f)
+        churn = sharding_mod.fleet_axis_constrain_tree(
+            churn, fleet_mesh, f)
+        fallback = sharding_mod.fleet_axis_constrain_tree(
+            fallback, fleet_mesh, f)
 
     def one(state, member_faults, member_churn, member_fallback):
         def body(carry, _):
@@ -491,11 +511,30 @@ def fleet_body(states, faults, churn, fallback, n_ticks: int,
 
         return lax.scan(body, state, None, length=n_ticks)
 
-    return jax.vmap(one)(states, faults, churn, fallback)
+    finals, logs = jax.vmap(one)(states, faults, churn, fallback)
+    if fleet_mesh is not None:
+        finals = sharding_mod.fleet_axis_constrain_tree(
+            finals, fleet_mesh, f)
+        logs = sharding_mod.fleet_axis_constrain_tree(logs, fleet_mesh, f)
+    return finals, logs
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6))
+@partial(jax.jit, static_argnums=(4, 5, 6, 7))
 def _fleet_simulate(states, faults, churn, fallback, n_ticks: int,
-                    settings: Settings, mesh=None):
+                    settings: Settings, mesh=None, fleet_mesh=None):
     return fleet_body(states, faults, churn, fallback, n_ticks, settings,
-                      mesh)
+                      mesh, fleet_mesh)
+
+
+# Donating the stacked carries lets XLA reuse the dispatch's input
+# buffers for its outputs: a pipelined campaign keeps at most the
+# in-flight working sets alive instead of input+output per dispatch.
+# Each stacked fleet is executed exactly once, so donation is safe —
+# the campaign driver drops its input reference at launch.
+_fleet_simulate_donated = partial(
+    jax.jit, static_argnums=(4, 5, 6, 7),
+    donate_argnums=(0, 1, 2, 3))(
+        lambda states, faults, churn, fallback, n_ticks, settings,
+        mesh=None, fleet_mesh=None: fleet_body(
+            states, faults, churn, fallback, n_ticks, settings, mesh,
+            fleet_mesh))

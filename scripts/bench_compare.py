@@ -58,11 +58,12 @@ PROTOCOL_RUN_KEYS = (
 )
 
 #: Seed-deterministic structural fields of one dispatch_timeline record
-#: (schema v5); stage walls, rates, and memory watermarks are
-#: machine-dependent and only warn.
+#: (schema v7 adds the pool identity and its stacking maxima); stage
+#: walls, rates, and memory watermarks are machine-dependent and only
+#: warn.
 DISPATCH_STRUCTURAL_KEYS = (
-    "index", "mode", "members", "pad_members", "fleet_size", "kinds",
-    "compiled", "padding",
+    "index", "mode", "pool_id", "pool_shape", "members", "pad_members",
+    "fleet_size", "kinds", "compiled", "padding",
 )
 
 #: Deterministic protocol counts inside the telemetry block, including
@@ -78,7 +79,9 @@ PROTOCOL_TELEMETRY_KEYS = (
 
 
 def compare_run(current: Dict, baseline: Dict, where: str,
-                tps_tolerance: float) -> Tuple[List[str], List[str]]:
+                tps_tolerance: float,
+                cps_tolerance: float = None
+                ) -> Tuple[List[str], List[str]]:
     """Diff one run payload; returns (errors, warnings)."""
     errors: List[str] = []
     warnings: List[str] = []
@@ -138,17 +141,26 @@ def compare_run(current: Dict, baseline: Dict, where: str,
                         f"{cur_d.get(key)!r} != baseline "
                         f"{base_d.get(key)!r}")
 
-    for rate_key in ("ticks_per_sec", "clusters_per_sec"):
+    # Throughput regressions are warn-only (wall clock is
+    # machine-dependent); clusters_per_sec — the fleet pipeline's
+    # headline rate — gets its own tolerance knob so campaign throughput
+    # can be watched tighter or looser than raw tick throughput.
+    rate_tolerances = (
+        ("ticks_per_sec", tps_tolerance),
+        ("clusters_per_sec",
+         tps_tolerance if cps_tolerance is None else cps_tolerance),
+    )
+    for rate_key, tolerance in rate_tolerances:
         cur_rate = current.get(rate_key)
         base_rate = baseline.get(rate_key)
         if isinstance(cur_rate, (int, float)) and \
                 isinstance(base_rate, (int, float)) and base_rate > 0:
-            if cur_rate < base_rate * (1.0 - tps_tolerance):
+            if cur_rate < base_rate * (1.0 - tolerance):
                 drop = 100.0 * (1.0 - cur_rate / base_rate)
                 warnings.append(
                     f"{where}.{rate_key}: {cur_rate} is {drop:.0f}% below "
                     f"baseline {base_rate} (tolerance "
-                    f"{tps_tolerance * 100:.0f}%)")
+                    f"{tolerance * 100:.0f}%)")
     return errors, warnings
 
 
@@ -260,7 +272,8 @@ def compare_profile_sweeps(current: Dict, baseline: Dict,
 
 def compare_payloads(current: Dict, baseline: Dict,
                      tps_tolerance: float,
-                     wall_tolerance: float = 0.50
+                     wall_tolerance: float = 0.50,
+                     cps_tolerance: float = None
                      ) -> Tuple[List[str], List[str]]:
     """Diff two schema-valid payloads (suite, single run, or sweep)."""
     cur_kind = current.get("bench")
@@ -277,11 +290,13 @@ def compare_payloads(current: Dict, baseline: Dict,
                     "fleet"):
             e, w = compare_run(current.get(key) or {},
                                baseline.get(key) or {},
-                               f"payload.{key}", tps_tolerance)
+                               f"payload.{key}", tps_tolerance,
+                               cps_tolerance)
             errors += e
             warnings += w
         return errors, warnings
-    return compare_run(current, baseline, "payload", tps_tolerance)
+    return compare_run(current, baseline, "payload", tps_tolerance,
+                       cps_tolerance)
 
 
 def main(argv=None) -> int:
@@ -295,6 +310,12 @@ def main(argv=None) -> int:
     parser.add_argument("--tps-tolerance", type=float, default=0.30,
                         help="warn when ticks_per_sec drops more than "
                              "this fraction below baseline (default 0.30)")
+    parser.add_argument("--cps-tolerance", type=float, default=0.30,
+                        help="warn when a fleet campaign's "
+                             "clusters_per_sec drops more than this "
+                             "fraction below baseline (default 0.30; "
+                             "warn-only — wall clock is machine-"
+                             "dependent)")
     parser.add_argument("--wall-tolerance", type=float, default=0.50,
                         help="warn when a profiled kernel's wall median "
                              "rises more than this fraction above the "
@@ -340,7 +361,8 @@ def main(argv=None) -> int:
 
     errors, warnings = compare_payloads(current, baseline,
                                         args.tps_tolerance,
-                                        args.wall_tolerance)
+                                        args.wall_tolerance,
+                                        args.cps_tolerance)
     for w in warnings:
         print(f"bench_compare: WARNING: {w}", file=sys.stderr)
     if errors:

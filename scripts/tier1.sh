@@ -92,10 +92,12 @@ fi
 # dispatch_timeline carries per-stage walls, (b) a parseable Perfetto
 # trace-event JSON, and (c) at least one JSONL heartbeat line. The
 # schema validator already enforces the stage-sum-vs-wall_s tolerance,
-# so this step only checks the artifacts exist and parse.
+# so this step only checks the artifacts exist and parse. Fleet size 2
+# forces a pool to span >=2 dispatches so the per-pool executable cache
+# provably hits (a compiled dispatch followed by a cache-hit one).
 if [ "$rc" -eq 0 ]; then
     if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
-            --clusters 8 --fleet-size 4 --n 32 --ticks 120 \
+            --clusters 8 --fleet-size 2 --n 32 --ticks 120 \
             --out /tmp/_t1_obs.json --trace /tmp/_t1_obs_trace.json \
             --progress /tmp/_t1_obs_progress.jsonl >/dev/null \
         && python -m rapid_tpu.telemetry.schema /tmp/_t1_obs.json \
@@ -116,6 +118,53 @@ sys.exit(0 if ok else 1)'; then
         echo OBSERVATORY_SMOKE=ok
     else
         echo OBSERVATORY_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Pipelined-dispatch smoke: the double-buffered campaign driver
+# (--pipeline, the default) must produce a payload bit-identical to the
+# serial driver (--no-pipeline) in every non-wall field — same pools,
+# same timeline structure, same folded telemetry — while the
+# observatory's pipeline block records which driver ran. The heartbeat
+# stream must validate against the v7 progress schema (pool identity +
+# live in-flight depth per dispatch).
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 8 --fleet-size 4 --n 32 --ticks 120 \
+            --progress /tmp/_t1_pipe_progress.jsonl \
+            --out /tmp/_t1_pipe.json >/dev/null \
+        && timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 8 --fleet-size 4 --n 32 --ticks 120 \
+            --no-pipeline --out /tmp/_t1_serial.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_pipe.json \
+        && python -m rapid_tpu.telemetry.schema --progress \
+            /tmp/_t1_pipe_progress.jsonl \
+        && python -c '
+import json, sys
+WALL = ("boot_s", "wall_s", "fold_s", "compile_s", "device_busy_s",
+        "host_blocked_s", "spot_check_s", "total_s", "ticks_per_sec",
+        "rounds_per_sec", "clusters_per_sec", "observatory")
+DISPATCH_WALL = ("stages", "wall_s", "clusters_per_sec",
+                 "host_blocked_frac", "memory")
+def strip(p):
+    p = {k: v for k, v in p.items() if k not in WALL}
+    p["dispatch_timeline"] = [
+        {k: v for k, v in r.items() if k not in DISPATCH_WALL}
+        for r in p["dispatch_timeline"]]
+    return p
+pipe = json.load(open("/tmp/_t1_pipe.json"))
+serial = json.load(open("/tmp/_t1_serial.json"))
+ok = (json.dumps(strip(pipe), sort_keys=True)
+      == json.dumps(strip(serial), sort_keys=True)
+      and pipe["observatory"]["pipeline"]["enabled"]
+      and pipe["observatory"]["pipeline"]["max_in_flight"] == 2
+      and not serial["observatory"]["pipeline"]["enabled"]
+      and serial["observatory"]["pipeline"]["peak_in_flight"] == 1)
+sys.exit(0 if ok else 1)'; then
+        echo PIPELINE_SMOKE=ok
+    else
+        echo PIPELINE_SMOKE=failed
         rc=1
     fi
 fi

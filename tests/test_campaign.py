@@ -1,4 +1,4 @@
-"""Campaign driver: determinism, schema v6 payloads, and fleet folds.
+"""Campaign driver: determinism, schema v7 payloads, and fleet folds.
 
 The campaign block of a bench payload is exact-compared by
 ``scripts/bench_compare.py``, so everything derived from the campaign
@@ -94,14 +94,22 @@ def test_campaign_is_deterministic_across_dispatches(tiny_payload,
     beats = [ln for ln in lines if ln["record"] == "dispatch"]
     assert len(beats) == len(again["dispatch_timeline"])
     assert beats[-1]["clusters_done"] == TINY.clusters
+    # v7: each heartbeat names its dispatch pool and the live pipeline
+    # depth, and the stream validates against the progress schema.
+    assert tschema.validate_progress_stream(
+        praw.decode().splitlines()) == []
+    for beat, rec in zip(beats, again["dispatch_timeline"]):
+        assert beat["pool_id"] == rec["pool_id"]
+        assert beat["pool_shape"] == rec["pool_shape"]
+        assert 0 <= beat["in_flight_dispatches"] < 2
     # spot checks run before any dispatch, so every heartbeat carries
     # the real failure count (0 here: TINY requests no spot checks)
     assert all(b["spot_failures"] == 0 for b in beats)
     assert lines[-1]["record"] == "campaign"
 
 
-def test_campaign_payload_passes_schema_v6(tiny_payload):
-    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 6
+def test_campaign_payload_passes_schema_v7(tiny_payload):
+    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 7
     assert tschema.validate_bench_payload(tiny_payload) == []
     camp = tiny_payload["campaign"]
     assert camp["clusters"] == TINY.clusters
@@ -129,6 +137,16 @@ def test_campaign_payload_passes_schema_v6(tiny_payload):
     assert latency_kinds <= set(regimes)
     for dist in regimes.values():
         assert set(dist) == {"count", "p50", "p90", "p99", "max"}
+    # v7: the dispatch plan's kind-homogeneous pools, reconciling with
+    # the timeline's dispatch count and member total.
+    pools = camp["pools"]
+    assert [p["pool_id"] for p in pools] == list(range(len(pools)))
+    assert sum(p["members"] for p in pools) == TINY.clusters
+    assert sum(p["dispatches"] for p in pools) == tiny_payload["dispatches"]
+    for p in pools:
+        assert sum(p["kinds"].values()) == p["members"]
+        assert p["fleet_size"] <= TINY.fleet_size
+        assert set(p["shape"]) == set(tschema.DISPATCH_PADDING_SPEC)
 
 
 def test_dispatch_timeline_observatory(tiny_payload):
@@ -157,6 +175,10 @@ def test_dispatch_timeline_observatory(tiny_payload):
         if rec["host_blocked_frac"] is not None:
             assert 0.0 <= rec["host_blocked_frac"] <= 1.0
         assert rec["memory"]["live_buffer_bytes"] >= 0
+        # v7: every record names its pool, and the pool's stacking
+        # maxima bound what any member could have needed.
+        assert rec["pool_id"] < len(tiny_payload["campaign"]["pools"])
+        assert set(rec["pool_shape"]) == set(tschema.DISPATCH_PADDING_SPEC)
 
     obs = tiny_payload["observatory"]
     assert tschema.validate_observatory(obs) == []
@@ -178,6 +200,14 @@ def test_dispatch_timeline_observatory(tiny_payload):
             assert info is None
     assert tiny_payload["clusters_per_sec"] is not None
     assert tiny_payload["total_s"] >= tiny_payload["wall_s"]
+    # v7: the pipeline block reports the double-buffer depth actually
+    # reached, and the per-pool compile ledger reconciles with the
+    # timeline's compiled flags.
+    pipe = obs["pipeline"]
+    assert pipe["enabled"] is True and pipe["max_in_flight"] == 2
+    assert 1 <= pipe["peak_in_flight"] <= pipe["max_in_flight"]
+    compiled_pools = {r["pool_id"] for r in timeline if r["compiled"]}
+    assert {p["pool_id"] for p in obs["compile"]["pools"]} == compiled_pools
 
 
 def test_campaign_straddling_both_dispatch_modes():
@@ -200,6 +230,82 @@ def test_campaign_straddling_both_dispatch_modes():
     for mode in ("shared", "per_receiver"):
         info = payload["observatory"]["compile"][mode]
         assert info is not None and info["compile_s"] > 0
+
+
+def test_pipelined_driver_matches_serial(tiny_payload):
+    """Tentpole pin: the double-buffered driver changes *when* the host
+    fences, not *what* the campaign computes — every non-wall field of
+    the payload is bit-identical to the serial (``pipeline=False``)
+    driver's, and only the observatory admits which driver ran."""
+    import dataclasses
+
+    serial = run_campaign(dataclasses.replace(TINY, pipeline=False))
+    assert json.dumps(_strip_wall(tiny_payload), sort_keys=True) == \
+        json.dumps(_strip_wall(serial), sort_keys=True)
+    assert serial["observatory"]["pipeline"] == {
+        "enabled": False, "max_in_flight": 1, "peak_in_flight": 1}
+
+
+#: Mixed crash+contested campaign for the pooled-padding pin: crash
+#: members lower to single-pid fallback tables, contested members to
+#: many-pid tables, so a global-maxima stack (the v6 behaviour) pads
+#: every crash member up to the contested pid count.
+POOLED = CampaignConfig(
+    clusters=8, n=16, ticks=60, seed=4, fleet_size=4, headroom=8,
+    weights=ScenarioWeights(
+        **{k: (1.0 if k in ("crash", "contested") else 0.0)
+           for k in SCENARIO_KINDS}))
+
+
+def test_pools_collapse_padding_below_global_maxima():
+    """Satellite: kind-homogeneous pools must beat the old single-
+    global-maxima stacking strictly on padding waste, and pool
+    membership must be deterministic in the campaign seed."""
+    from rapid_tpu.campaign import (_build_pools, _sample_scenario,
+                                    _shared_dims)
+
+    payload = run_campaign(POOLED)
+    camp = payload["campaign"]
+    kinds = camp["scenario_kinds"]
+    assert set(kinds) == {"crash", "contested"} and min(kinds.values()) >= 2
+
+    # Reconstruct the old driver's waste: every shared member padded to
+    # the campaign-global maxima across *all* shared members.
+    scenarios = [_sample_scenario(POOLED, i) for i in range(POOLED.clusters)]
+    dims = [_shared_dims(sc) for sc in scenarios]
+    global_shape = tuple(max(d[j] for d in dims) for j in range(3))
+    f = POOLED.fleet_size
+    n_dispatch = -(-len(dims) // f)
+    global_padding = {
+        "window_rows": n_dispatch * f * global_shape[0],
+        "fallback_instances": n_dispatch * f * global_shape[1],
+        "fallback_pids": n_dispatch * f * global_shape[2],
+    }
+    for d in dims:  # live rows don't count as waste (trailing pads do)
+        global_padding["window_rows"] -= d[0]
+        global_padding["fallback_instances"] -= d[1]
+        global_padding["fallback_pids"] -= d[2]
+
+    pooled_padding = {k: sum(r["padding"][k]
+                             for r in payload["dispatch_timeline"])
+                      for k in ("window_rows", "fallback_instances",
+                                "fallback_pids")}
+    assert sum(pooled_padding.values()) < sum(global_padding.values())
+    # The dominant waste axis — inert contested pid rows on crash
+    # members — collapses outright within the crash pool.
+    assert pooled_padding["fallback_pids"] < global_padding["fallback_pids"]
+
+    # Pool membership is a pure function of the sampled scenarios.
+    rebuilt = _build_pools(scenarios, list(range(POOLED.clusters)), [], f)
+    assert [p["members"] for p in rebuilt] == \
+        [p["members"] for p in _build_pools(
+            scenarios, list(range(POOLED.clusters)), [], f)]
+    assert sorted(i for p in rebuilt for i in p["members"]) == \
+        list(range(POOLED.clusters))
+    # Each pool is kind-pure on the axis that defines it: no crash
+    # member shares a pool with a contested member.
+    for p in camp["pools"]:
+        assert len(p["kinds"]) == 1
 
 
 def test_merge_summaries_zero_decide_and_single_member():

@@ -147,6 +147,48 @@ def test_inert_padding_changes_nothing():
     _assert_tree_equal(base[0], alt[0], "padded final state")
 
 
+def test_boot_cache_is_bit_transparent():
+    """Memoized boot state (shared and per-receiver) must be invisible:
+    lowering the same schedules with a cold cache and a warm cache
+    yields bit-identical members — including churn members, whose
+    id-fingerprint limbs are patched onto the cached template."""
+    churn_weights = _only("churn")
+    schedules = [random_adversary_schedule(N, seed=s, ticks=TICKS)
+                 for s in (3, 8)]
+    churn_sc = sample_adversary_schedule(N, 7, TICKS, churn_weights)
+    assert churn_sc.wants_churn
+    link_weights = ScenarioWeights(
+        **{k: (1.0 if k == "partition" else 0.0) for k in SCENARIO_KINDS})
+    rx_schedules = [sample_adversary_schedule(
+        N, s, 80, link_weights).schedule for s in (2, 5)]
+
+    def lower_all():
+        from rapid_tpu.engine import churn as churn_mod
+
+        members = [fleet_mod.lower_schedule(s, SETTINGS)
+                   for s in schedules]
+        churn_plan, id_fps, _ = churn_mod.synthetic_churn_schedule(
+            N + 8, N, SETTINGS.with_(capacity=N + 8), start=10, burst=4)
+        members.append(fleet_mod.lower_schedule(
+            churn_sc.schedule, SETTINGS.with_(capacity=N + 8),
+            churn=churn_plan, id_fps=id_fps))
+        rx_members = [fleet_mod.lower_receiver_schedule(s, SETTINGS)
+                      for s in rx_schedules]
+        return members, rx_members
+
+    fleet_mod.clear_boot_caches()
+    cold_members, cold_rx = lower_all()   # populates the caches
+    warm_members, warm_rx = lower_all()   # every boot is a cache hit
+    for i, (cold, warm) in enumerate(zip(cold_members, warm_members)):
+        _assert_tree_equal(cold.state, warm.state, f"member {i} state")
+    for i, (cold, warm) in enumerate(zip(cold_rx, warm_rx)):
+        _assert_tree_equal(cold.state, warm.state, f"rx member {i} state")
+    # Distinct seeds must not collapse onto one cached delay table.
+    assert not np.array_equal(
+        np.asarray(cold_rx[0].state.delay_table),
+        np.asarray(cold_rx[1].state.delay_table))
+
+
 def test_pad_link_windows_rejects_shrink():
     m = fleet_mod.lower_schedule(
         random_adversary_schedule(N, seed=1, ticks=60), SETTINGS)

@@ -291,3 +291,106 @@ def test_shard_put_places_state_on_mesh(mesh):
     # scalar leaves (the tick counter, config-id limbs) stay replicated
     shardings = sharding.state_shardings(state, mesh)
     assert tuple(shardings.tick.spec) == ()
+
+
+# ---------------------------------------------------------------------------
+# fleet-axis sharding: P('fleet') over whole members (campaign layout)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_mesh():
+    if len(jax.devices()) < N_DEVICES:
+        pytest.skip("needs the conftest-forced 8-device CPU mesh")
+    return sharding.fleet_axis_mesh(N_DEVICES)
+
+
+def test_fleet_axis_parity_shared_f8(fleet_mesh):
+    """An F=8 shared-state fleet with one member per device == the
+    unsharded fleet, bit for bit, and the member axis is genuinely
+    P('fleet') on both inputs and outputs."""
+    n, ticks = 16, 80
+    members = [fleet_mod.lower_schedule(
+        random_adversary_schedule(n, seed=s, ticks=ticks), SETTINGS)
+        for s in range(8)]
+    fleet = fleet_mod.stack_members(members)
+
+    base_finals, base_logs = fleet_mod.fleet_simulate(fleet, ticks,
+                                                      SETTINGS)
+    placed = sharding.fleet_axis_put(fleet, fleet_mesh, 8)
+    s_finals, s_logs = fleet_mod.fleet_simulate(placed, ticks, SETTINGS,
+                                                fleet_mesh=fleet_mesh)
+    _assert_tree_equal(base_logs, s_logs, "fleet-axis logs")
+    _assert_tree_equal(base_finals, s_finals, "fleet-axis final states")
+    assert tuple(placed.state.member.sharding.spec)[0] == \
+        sharding.FLEET_AXIS
+    assert tuple(s_finals.member.sharding.spec)[0] == sharding.FLEET_AXIS
+
+
+def test_fleet_axis_parity_receiver_f8(fleet_mesh):
+    """The per-receiver fleet path shards its member axis the same way
+    and stays bit-identical."""
+    from rapid_tpu.faults import (SCENARIO_KINDS, ScenarioWeights,
+                                  sample_adversary_schedule)
+
+    link_weights = ScenarioWeights(
+        **{k: (1.0 if k in ("partition", "flip_flop") else 0.0)
+           for k in SCENARIO_KINDS})
+    schedules = [sample_adversary_schedule(16, s, 80, link_weights).schedule
+                 for s in range(8)]
+    members = [fleet_mod.lower_receiver_schedule(s, SETTINGS)
+               for s in schedules]
+    fleet = fleet_mod.stack_receiver_members(members)
+
+    base_finals, base_logs = fleet_mod.receiver_fleet_simulate(
+        fleet, 80, SETTINGS)
+    placed = sharding.fleet_axis_put(fleet, fleet_mesh, 8)
+    s_finals, s_logs = fleet_mod.receiver_fleet_simulate(
+        placed, 80, SETTINGS, fleet_mesh=fleet_mesh)
+    _assert_tree_equal(base_logs, s_logs, "rx fleet-axis logs")
+    _assert_tree_equal(base_finals, s_finals, "rx fleet-axis finals")
+    assert tuple(s_finals.member.sharding.spec)[0] == sharding.FLEET_AXIS
+
+
+def test_fleet_axis_spec_unit(fleet_mesh):
+    """Axis 0 shards iff it is the fleet axis and divides the mesh;
+    everything else — scalars, constants, non-dividing fleets —
+    replicates."""
+    assert sharding.fleet_axis_spec_for((8,), 8, fleet_mesh) == \
+        P(sharding.FLEET_AXIS)
+    assert sharding.fleet_axis_spec_for((8, 24, 24), 8, fleet_mesh) == \
+        P(sharding.FLEET_AXIS)
+    # a non-dividing fleet replicates (divisibility guard)
+    assert sharding.fleet_axis_spec_for((6, 24), 6, fleet_mesh) == P()
+    # a leaf without the fleet axis (static LUT) replicates
+    assert sharding.fleet_axis_spec_for((256, 8), 8, fleet_mesh) == P()
+    assert sharding.fleet_axis_spec_for((), 8, fleet_mesh) == P()
+
+
+def test_fleet_axis_excludes_slot_mesh(mesh, fleet_mesh):
+    """The two layouts are mutually exclusive per dispatch — asking for
+    both is a contract violation, not silent precedence."""
+    n = 16
+    members = [fleet_mod.lower_schedule(
+        random_adversary_schedule(n, seed=s, ticks=40), SETTINGS)
+        for s in range(2)]
+    fleet = fleet_mod.stack_members(members)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        step_mod.fleet_body(fleet.state, fleet.faults, fleet.churn,
+                            fleet.fallback, 40, SETTINGS, mesh=mesh,
+                            fleet_mesh=fleet_mesh)
+
+
+def test_fleet_axis_default_path_traces_no_constraints():
+    """fleet_mesh=None must trace the byte-identical pre-sharding
+    jaxpr — zero sharding-constraint eqns on the default path."""
+    n = 16
+    members = [fleet_mod.lower_schedule(
+        random_adversary_schedule(n, seed=s, ticks=40), SETTINGS)
+        for s in range(2)]
+    fleet = fleet_mod.stack_members(members)
+    specs = _constraint_specs(
+        lambda st, fa, ch, fb: step_mod.fleet_body(st, fa, ch, fb, 40,
+                                                   SETTINGS),
+        fleet.state, fleet.faults, fleet.churn, fleet.fallback)
+    assert specs == []

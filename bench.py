@@ -3,9 +3,9 @@
 + delay + fleet suite, JSON out.
 
 This is the harness entry point (``python bench.py``): it runs the
-engine tick benchmark six times — an N=1k steady crash-burst, an N=1k
-sustained-churn run, an N=1k contested-consensus run through the
-classic-Paxos fallback kernel, a small one-way-partition run
+engine tick benchmark six times — an N=256 steady crash-burst, an
+N=256 sustained-churn run, an N=256 contested-consensus run through
+the classic-Paxos fallback kernel, a small one-way-partition run
 through the fault adversary (a host-side oracle differential, so it
 uses its own ``--partition-n`` size), a latency-adversary ``delay``
 campaign (every member draws from the delay/jitter/slow-asym family,
@@ -27,6 +27,19 @@ rows included — goes to ``--out FILE`` (indented). Each sub-payload
 carries the per-run protocol summary in its ``telemetry`` block
 (``rapid_tpu.telemetry.metrics.RunSummary``); both forms validate with::
 
+Wall-budget discipline: a bare ``python bench.py`` must finish inside a
+capture harness's budget and must leave a parseable stdout tail even
+when it doesn't. The defaults therefore match the tier-1 regression
+config (N=256 — the config ``scripts/tier1.sh`` proves out every run);
+``--fast`` shrinks every knob further for smoke use. Entries run one at
+a time with a stderr progress line each, and the final stdout line is
+emitted from a ``finally`` block with a SIGTERM handler installed — a
+budget kill (``timeout``'s TERM, before the KILL escalation) still
+flushes a payload carrying the completed entries plus a ``partial``
+block naming what was cut and why (exit 1, and schema validation fails
+loudly on the missing entries — a partial record is diagnosable, an
+empty tail is not).
+
     python -m rapid_tpu.telemetry.schema BENCH.json
 
 ``scripts/bench_compare.py`` diffs the ``--out`` artifact against the
@@ -40,7 +53,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -56,6 +71,27 @@ from benchmarks.bench_engine import (  # noqa: E402
 )
 
 
+#: Suite entries in run order (heaviest last, so a budget cut keeps the
+#: cheap protocol entries).
+SUITE_ENTRIES = ("steady", "churn", "contested", "partition", "delay",
+                 "fleet")
+
+#: ``--fast`` preset: every knob shrunk to smoke scale. Applied only to
+#: knobs the caller left at their defaults, so ``--fast --n 512`` still
+#: honors the explicit 512.
+FAST_PRESET = {
+    "n": 128, "ticks": 96, "partition_n": 32, "partition_ticks": 200,
+    "delay_clusters": 4, "delay_n": 32, "delay_ticks": 160,
+    "fleet_clusters": 16, "fleet_size": 8, "fleet_n": 32,
+    "fleet_ticks": 96,
+}
+
+
+class _BudgetCut(Exception):
+    """Raised by the SIGTERM/SIGINT handler: the harness wall budget
+    expired mid-suite and wants us gone — flush what we have."""
+
+
 def _compact_payload(payload: dict) -> dict:
     """Summary-only form for the stdout line.
 
@@ -63,11 +99,13 @@ def _compact_payload(payload: dict) -> dict:
     (one record per decided proposal); eliding them — with an explicit
     ``view_changes_elided`` count so their absence is visible — keeps the
     last stdout line compact for tail-capture harnesses. The ``--out``
-    artifact keeps the full rows.
+    artifact keeps the full rows. Entries a partial run never reached
+    are simply absent.
     """
     out = dict(payload)
-    for key in ("steady", "churn", "contested", "partition", "delay",
-                "fleet"):
+    for key in SUITE_ENTRIES:
+        if key not in out:
+            continue
         run_p = dict(out[key])
         tel = dict(run_p["telemetry"])
         tel["view_changes_elided"] = len(tel.get("view_changes") or [])
@@ -79,8 +117,15 @@ def _compact_payload(payload: dict) -> dict:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--n", type=int, default=1_000,
-                        help="simulated cluster size (default 1k)")
+    parser.add_argument("--n", type=int, default=256,
+                        help="simulated cluster size (default 256 — the "
+                             "tier-1 regression config, sized to finish "
+                             "a bare run inside a capture harness's "
+                             "wall budget)")
+    parser.add_argument("--fast", action="store_true",
+                        help="smoke preset: shrink every knob still at "
+                             "its default to smoke scale "
+                             f"({FAST_PRESET})")
     parser.add_argument("--ticks", type=int, default=120,
                         help="simulated ticks per run (default 120)")
     parser.add_argument("--burst", type=int, default=8,
@@ -124,6 +169,10 @@ def main(argv=None) -> int:
                         help="write the JSON artifact to FILE "
                              "(default: stdout)")
     args = parser.parse_args(argv)
+    if args.fast:
+        for knob, value in FAST_PRESET.items():
+            if getattr(args, knob) == parser.get_default(knob):
+                setattr(args, knob, value)
 
     from rapid_tpu.engine.fleet import enable_compile_cache
     from rapid_tpu.settings import Settings
@@ -135,35 +184,68 @@ def main(argv=None) -> int:
     enable_compile_cache()
 
     settings = Settings()
+    entries = {
+        "steady": lambda: run(args.n, args.ticks, crash_frac=0.01,
+                              crash_tick=5, settings=settings,
+                              seed=args.seed),
+        "churn": lambda: run_churn(args.n, args.ticks, args.burst,
+                                   settings, args.seed),
+        "contested": lambda: run_contested(args.n, args.ticks, settings,
+                                           args.seed),
+        "partition": lambda: run_partition(args.partition_n,
+                                           args.partition_ticks,
+                                           settings, args.seed),
+        "delay": lambda: run_delay(args.delay_clusters, args.delay_n,
+                                   args.delay_ticks, settings, args.seed,
+                                   fleet_size=args.delay_clusters),
+        "fleet": lambda: run_fleet(args.fleet_clusters, args.fleet_n,
+                                   args.fleet_ticks, settings, args.seed,
+                                   fleet_size=args.fleet_size),
+    }
     payload = {
         "bench": "engine_tick_suite",
         "schema_version": SCHEMA_VERSION,
         "n": args.n,
         "ticks": args.ticks,
-        "steady": run(args.n, args.ticks, crash_frac=0.01, crash_tick=5,
-                      settings=settings, seed=args.seed),
-        "churn": run_churn(args.n, args.ticks, args.burst, settings,
-                           args.seed),
-        "contested": run_contested(args.n, args.ticks, settings, args.seed),
-        "partition": run_partition(args.partition_n, args.partition_ticks,
-                                   settings, args.seed),
-        "delay": run_delay(args.delay_clusters, args.delay_n,
-                           args.delay_ticks, settings, args.seed,
-                           fleet_size=args.delay_clusters),
-        "fleet": run_fleet(args.fleet_clusters, args.fleet_n,
-                           args.fleet_ticks, settings, args.seed,
-                           fleet_size=args.fleet_size),
     }
-    if args.out:
-        from rapid_tpu.telemetry import write_json_artifact
 
-        write_json_artifact(args.out, payload, indent=2)
-    # The compact summary line always goes to stdout (flushed) so the
-    # harness's tail-capture works whether or not --out was given.
-    sys.stdout.write(
-        json.dumps(_compact_payload(payload), separators=(",", ":")) + "\n")
-    sys.stdout.flush()
-    return 0
+    def _cut(signum, frame):
+        raise _BudgetCut(signal.Signals(signum).name)
+
+    prev = {sig: signal.signal(sig, _cut)
+            for sig in (signal.SIGTERM, signal.SIGINT)}
+    partial = None
+    try:
+        for name in SUITE_ENTRIES:
+            t0 = time.perf_counter()
+            payload[name] = entries[name]()
+            print(f"bench: {name} done in "
+                  f"{time.perf_counter() - t0:.1f}s", file=sys.stderr,
+                  flush=True)
+    except Exception as err:  # flush what we have, then exit nonzero
+        done = [name for name in SUITE_ENTRIES if name in payload]
+        partial = {"completed": done,
+                   "missing": [name for name in SUITE_ENTRIES
+                               if name not in payload],
+                   "error": f"{type(err).__name__}: {err}"}
+        payload["partial"] = partial
+        print(f"bench: PARTIAL after {done} ({partial['error']})",
+              file=sys.stderr, flush=True)
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+        if args.out:
+            from rapid_tpu.telemetry import write_json_artifact
+
+            write_json_artifact(args.out, payload, indent=2)
+        # The compact summary line always goes to stdout (flushed) so
+        # the harness's tail-capture works whether or not --out was
+        # given — on a budget cut it carries whatever completed.
+        sys.stdout.write(
+            json.dumps(_compact_payload(payload),
+                       separators=(",", ":")) + "\n")
+        sys.stdout.flush()
+    return 1 if partial else 0
 
 
 if __name__ == "__main__":

@@ -129,6 +129,35 @@ MIN_MEASURABLE_WALL_S = 1e-3
 #: multiply the live working set.
 PIPELINE_DEPTH = 2
 
+#: Anomaly classes of the post-dispatch triage classifier, in report
+#: order. Every member is tested against every class (membership is not
+#: exclusive); counts and exemplar refs are seed-deterministic, so
+#: ``scripts/bench_compare.py`` exact-gates the whole ``triage`` block.
+TRIAGE_CLASSES = (
+    "no_decide_by_deadline",   # never decided within cfg.ticks
+    "slow_decide",             # decided past the campaign p99 tail
+    "invariant_violations",    # on-device invariant monitor tripped
+    "envelope_flags",          # per-receiver sticky envelope flags
+    "excess_fallback",         # unexpected / repeated classic-Paxos rounds
+    "spot_failures",           # host oracle referee divergence
+)
+
+#: Kinds for which classic-Paxos fallback traffic is the *expected*
+#: resolution path (contested splits by construction; latency members
+#: can starve the fast round into the timer path). Any other kind
+#: sending classic traffic is an anomaly.
+EXPECTED_FALLBACK_KINDS = ("contested",) + DELAY_KINDS
+
+#: Classic rounds at/above which even an expected-fallback member is
+#: flagged (one round is the designed resolution; repeats mean the
+#: fallback itself is thrashing).
+EXCESS_FALLBACK_ROUNDS = 2
+
+#: Exemplar member refs embedded per triage class (first in campaign
+#: index order — deterministic). Bounds the payload and the recorder
+#: rings extracted to host at any fleet size.
+MAX_TRIAGE_EXEMPLARS = 4
+
 
 def _rate(numerator: float, wall_s: float) -> Optional[float]:
     """``numerator / wall_s``, or None when the wall is unmeasurable."""
@@ -204,6 +233,12 @@ class CampaignConfig:
     # each pool's program from disk instead of re-running LLVM. Same
     # programs bit-for-bit — only compile wall changes.
     compile_cache: bool = True
+    # On-device flight recorder window W (engine.recorder): > 0 threads
+    # a bounded [W, G] gauge ring + first-occurrence stamps through
+    # every member's scan and embeds the rings of triage-flagged
+    # exemplars in the payload. 0 (default) compiles the recorder out —
+    # byte-identical member programs to a recorder-less build.
+    flight_recorder: int = 0
 
 
 def _receiver_eligible(sc: SampledScenario) -> bool:
@@ -454,6 +489,129 @@ def _spot_check(cfg: CampaignConfig, scenarios: List[SampledScenario],
     return block
 
 
+def _expected_block(s, meta: Dict[str, object]) -> Dict[str, object]:
+    """The bit-identity contract one member's replay must reproduce
+    (``rapid_tpu.replay`` re-runs the member unbatched and diffs every
+    field here against the fresh fold)."""
+    return {
+        "ticks_to_first_announce": s.ticks_to_first_announce,
+        "ticks_to_first_decide": s.ticks_to_first_decide,
+        "announcements": s.announcements,
+        "decisions": s.decisions,
+        "invariant_violations": s.invariant_violations,
+        "counter_totals": {
+            "sent": s.total_sent, "delivered": s.total_delivered,
+            "dropped": s.total_dropped, "timeouts": s.total_timeouts,
+            "probes_sent": s.total_probes_sent,
+            "probes_failed": s.total_probes_failed},
+        "fallback_phase_sent": dict(s.fallback_phase_sent),
+        "config_ids": list(meta["config_ids"]),
+        "flags": meta["flags"],
+    }
+
+
+def _classic_rounds(s, n: int) -> int:
+    """Estimated classic-Paxos rounds from phase-1a traffic (one round
+    is one coordinator broadcast to ~n acceptors; the factor fold makes
+    the totals exact, so the estimate is deterministic)."""
+    p1a = int(s.fallback_phase_sent.get("phase1a", 0))
+    return -(-p1a // max(1, n - 1)) if p1a else 0
+
+
+def _triage(cfg: CampaignConfig, scenarios, summaries, member_order,
+            member_meta, dists, spot) -> Dict[str, object]:
+    """Classify every member into ``TRIAGE_CLASSES``; returns the
+    schema-v8 ``campaign.triage`` block (recorder rings are attached to
+    exemplars by the caller, which owns the per-dispatch host copies).
+
+    Every field is derived from seed-deterministic folds — no
+    wall-clock values — so ``bench_compare``'s exact campaign-block
+    gate covers the whole block.
+    """
+    tail = dists.get("ticks_to_first_decide") or {}
+    slow_thr = tail.get("p99")
+    per_member_classes: Dict[int, List[str]] = {}
+
+    def hits(s, meta, kind) -> List[str]:
+        out = []
+        if s.ticks_to_first_decide is None:
+            out.append("no_decide_by_deadline")
+        elif slow_thr is not None and s.ticks_to_first_decide > slow_thr:
+            out.append("slow_decide")
+        if s.invariant_violations:
+            out.append("invariant_violations")
+        if meta["flags"]:
+            out.append("envelope_flags")
+        classic = sum(int(s.fallback_phase_sent.get(p, 0))
+                      for p in ("phase1a", "phase1b", "phase2a", "phase2b"))
+        if classic and (kind not in EXPECTED_FALLBACK_KINDS
+                        or _classic_rounds(s, cfg.n)
+                        >= EXCESS_FALLBACK_ROUNDS):
+            out.append("excess_fallback")
+        return out
+
+    classes: Dict[str, Dict[str, object]] = {
+        name: {"count": 0, "by_kind": {}, "exemplars": []}
+        for name in TRIAGE_CLASSES}
+    for pos, i in enumerate(member_order):
+        s, meta = summaries[pos], member_meta[pos]
+        kind = scenarios[i].kind
+        names = hits(s, meta, kind)
+        if names:
+            per_member_classes[i] = names
+        for name in names:
+            block = classes[name]
+            block["count"] += 1
+            block["by_kind"][kind] = block["by_kind"].get(kind, 0) + 1
+            if len(block["exemplars"]) < MAX_TRIAGE_EXEMPLARS:
+                block["exemplars"].append({
+                    "dispatch": meta["dispatch"],
+                    "member_index": meta["member_index"],
+                    "member": i, "kind": kind, "mode": meta["mode"],
+                    "seed": _member_seed(cfg, i),
+                    "expected": _expected_block(s, meta),
+                    "recorder": None,
+                })
+
+    ref_by_member = {i: (member_meta[pos]["dispatch"],
+                         member_meta[pos]["member_index"],
+                         member_meta[pos]["mode"])
+                     for pos, i in enumerate(member_order)}
+    sf = classes["spot_failures"]
+    for rec in spot.get("members", ()):
+        if rec["passed"]:
+            continue
+        i = rec["member"]
+        d, j, mode = ref_by_member.get(i, (-1, -1, rec["mode"]))
+        sf["count"] += 1
+        sf["by_kind"][rec["kind"]] = sf["by_kind"].get(rec["kind"], 0) + 1
+        if i >= 0:
+            per_member_classes.setdefault(i, []).append("spot_failures")
+        if len(sf["exemplars"]) < MAX_TRIAGE_EXEMPLARS:
+            sf["exemplars"].append({
+                "dispatch": d, "member_index": j, "member": i,
+                "kind": rec["kind"], "mode": mode, "seed": rec["seed"],
+                "expected": None, "recorder": None,
+            })
+
+    for block in classes.values():
+        block["by_kind"] = dict(sorted(block["by_kind"].items()))
+    recorder_cfg = None
+    if cfg.flight_recorder:
+        from rapid_tpu.engine import recorder as recorder_lib
+
+        recorder_cfg = {"window": cfg.flight_recorder,
+                        "gauges": list(recorder_lib.GAUGE_NAMES)}
+    return {
+        "clusters": len(member_order),
+        "flagged_members": len(per_member_classes),
+        "thresholds": {"slow_decide_p99": slow_thr,
+                       "excess_fallback_rounds": EXCESS_FALLBACK_ROUNDS},
+        "recorder": recorder_cfg,
+        "classes": classes,
+    }
+
+
 def _live_buffer_bytes(jax) -> int:
     """Process-wide live device-buffer watermark (bytes)."""
     try:
@@ -505,8 +663,10 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     only.
     """
     import jax
+    import numpy as np
 
     from rapid_tpu.engine import receiver as receiver_mod
+    from rapid_tpu.engine import recorder as recorder_mod
     from rapid_tpu.engine import sharding as sharding_mod
     from rapid_tpu.engine.fleet import (check_receiver_budget,
                                         fleet_aot_compile,
@@ -530,6 +690,12 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     # headroom — the quadratic state is sized to N, not N + headroom.
     rx_settings = base if base.capacity == cfg.n \
         else base.with_(capacity=cfg.n)
+    # The recorder rides the member settings only: the referee replays
+    # host-side and must keep tracing the recorder-less programs.
+    if cfg.flight_recorder:
+        settings = settings.with_(flight_recorder_window=cfg.flight_recorder)
+        rx_settings = rx_settings.with_(
+            flight_recorder_window=cfg.flight_recorder)
     f = max(1, cfg.fleet_size)
     # Sampled membership rounds up to whole fleets of f (the historical
     # contract); the pooled plan below may split those members into more
@@ -591,6 +757,15 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     executables: Dict[int, object] = {}
     summaries = []
     member_order: List[int] = []  # member index per summaries[] entry
+    # Per-member triage inputs, aligned with summaries/member_order:
+    # mode, (dispatch, member_index) ref, sticky flags word, final
+    # config ids. Plus the host copy of each dispatch's recorder rings
+    # (the compact [F, W, G] carry — bounded by design; the full
+    # [F, T, ...] logs never leave the fold).
+    member_meta: List[Dict[str, object]] = []
+    dispatch_recs: Dict[int, object] = {}
+    anomalies = {"no_decide_by_deadline": 0, "invariant_violations": 0,
+                 "envelope_flags": 0}
     rx_dispatches = 0
     done = 0
     in_flight: List[Dict[str, object]] = []  # FIFO, launch order
@@ -724,12 +899,26 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         # Computation done: dropping the input reference is now free, and
         # the donated buffers it pinned are released before the fold.
         entry.pop("fleet")
-        finals, logs = entry["result"]
+        if cfg.flight_recorder:
+            finals, logs, recs = entry["result"]
+            # Host copy of the compact recorder carry; triage slices
+            # out only the flagged members' rings at the end.
+            dispatch_recs[d] = jax.tree_util.tree_map(np.asarray, recs)
+        else:
+            finals, logs = entry["result"]
         t0 = time.perf_counter()
         with wall_span(writer, "fold",
                        {"dispatch": d, "mode": mode, "pool": pid}):
             if mode == "shared":
                 summaries.extend(fleet_summaries(logs)[:len(chunk)])
+                cfg_hi = np.asarray(logs.config_hi)[:len(chunk), -1]
+                cfg_lo = np.asarray(logs.config_lo)[:len(chunk), -1]
+                for j in range(len(chunk)):
+                    cid = int(cfg_hi[j]) << 32 | int(cfg_lo[j])
+                    member_meta.append({
+                        "dispatch": d, "member_index": j,
+                        "mode": mode, "flags": 0,
+                        "config_ids": [f"{cid:016x}"]})
             else:
                 rx_dispatches += 1
                 for j in range(len(chunk)):
@@ -737,15 +926,31 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                                                  finals)
                     mlog = jax.tree_util.tree_map(lambda x, j=j: x[j],
                                                   logs)
-                    # A nonzero envelope flag would void the
-                    # device-exact claim for this member; eligibility
-                    # keeps schedules inside the envelope, so this
-                    # raising means an engine bug.
-                    receiver_mod.check_flags(mrs.flags)
+                    # A nonzero envelope flag voids the device-exact
+                    # claim for this member; it used to abort the
+                    # campaign, now it lands in the triage
+                    # ``envelope_flags`` class (with the flag word in
+                    # the member record) so a 100k-cluster campaign
+                    # reports the escape instead of dying on it.
+                    flags = int(np.asarray(mrs.flags))
+                    cids = sorted(set(
+                        receiver_mod.receiver_config_ids(mrs)[:cfg.n]))
+                    member_meta.append({
+                        "dispatch": d, "member_index": j,
+                        "mode": mode, "flags": flags,
+                        "config_ids": [f"{cid:016x}" for cid in cids]})
                     run = receiver_mod.receiver_run_payload(
                         mrs, mlog, cfg.n, cfg.ticks)
                     summaries.append(summarize(run.metrics()))
             member_order.extend(chunk)
+            for s, meta in zip(summaries[-len(chunk):],
+                               member_meta[-len(chunk):]):
+                if s.ticks_to_first_decide is None:
+                    anomalies["no_decide_by_deadline"] += 1
+                if s.invariant_violations:
+                    anomalies["invariant_violations"] += 1
+                if meta["flags"]:
+                    anomalies["envelope_flags"] += 1
             # The memory watermark walks every live buffer in the
             # process — real host work, so it bills to the fold stage
             # rather than hiding as unaccounted glue between stages.
@@ -786,7 +991,8 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                        "in_flight_dispatches": len(in_flight),
                        "clusters_done": done,
                        "clusters_total": total, "stages": rec["stages"],
-                       "spot_failures": spot["failed"]})
+                       "spot_failures": spot["failed"],
+                       "anomalies": dict(anomalies)})
         return rec
 
     # The driver: launch each planned dispatch, retiring the oldest
@@ -830,10 +1036,27 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             regime_ticks[regime].append(s.ticks_to_first_decide)
     delay_regimes = regime_distributions(regime_ticks)
 
+    # Post-dispatch triage: classify every member, then attach the
+    # flight-recorder rings of the (bounded) exemplar set only — the
+    # per-dispatch host copies hold every member's compact ring, but
+    # only flagged exemplars reach the payload.
+    triage = _triage(cfg, scenarios, summaries, member_order, member_meta,
+                     dists, spot)
+    if cfg.flight_recorder:
+        for block in triage["classes"].values():
+            for ex in block["exemplars"]:
+                recs = dispatch_recs.get(ex["dispatch"])
+                if recs is not None and ex["member_index"] >= 0:
+                    ex["recorder"] = recorder_mod.recorder_payload(
+                        recorder_mod.member_recorder(
+                            recs, ex["member_index"]))
+
     progress.emit({"record": "campaign", "clusters_total": total,
                    "dispatches": len(timeline),
                    "wall_s": round(wall_s, 6),
-                   "spot_failures": spot["failed"]})
+                   "spot_failures": spot["failed"],
+                   "anomalies": dict(anomalies),
+                   "flagged_members": triage["flagged_members"]})
     progress.close()
     if writer is not None:
         writer.write(trace_path)
@@ -950,6 +1173,15 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         "campaign": {
             "seed": cfg.seed,
             "clusters": total,
+            # Replay self-containment (schema v8): everything
+            # ``rapid_tpu.replay`` needs to reconstruct the sampled
+            # schedules and the dispatch plan from this block alone.
+            "n": cfg.n,
+            "ticks": cfg.ticks,
+            "headroom": cfg.headroom,
+            "weights": dataclasses.asdict(
+                cfg.weights or DEFAULT_SCENARIO_WEIGHTS),
+            "flight_recorder": cfg.flight_recorder,
             "fleet_size": f,
             "dispatches": dispatches,
             "scenario_kinds": dict(sorted(kinds.items())),
@@ -958,6 +1190,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             "spot_checks": spot,
             "distributions": dists,
             "delay_regimes": delay_regimes,
+            "triage": triage,
         },
     }
 
@@ -1044,6 +1277,14 @@ def main(argv=None) -> int:
                         help="shard each dispatch's fleet axis over D "
                              "devices (P('fleet'), no collectives); "
                              "errors if fewer devices exist")
+    parser.add_argument("--flight-recorder", type=int, default=0,
+                        metavar="W",
+                        help="on-device flight recorder window: carry a "
+                             "[W, G] per-tick gauge ring + first-"
+                             "occurrence stamps through every member's "
+                             "scan and embed the rings of triage-flagged "
+                             "exemplars in the payload (0 = compiled "
+                             "out, byte-identical member programs)")
     args = parser.parse_args(argv)
 
     cfg = CampaignConfig(clusters=args.clusters, n=args.n, ticks=args.ticks,
@@ -1055,7 +1296,8 @@ def main(argv=None) -> int:
                          artifact_dir=args.spot_artifacts,
                          pipeline=args.pipeline,
                          fleet_shard=args.fleet_shard,
-                         compile_cache=args.compile_cache)
+                         compile_cache=args.compile_cache,
+                         flight_recorder=args.flight_recorder)
     payload = run_campaign(cfg, trace_path=args.trace,
                            progress_path=args.progress)
     if args.out:

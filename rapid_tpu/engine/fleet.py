@@ -423,8 +423,11 @@ def fleet_simulate(fleet: FleetMember, n_ticks: int,
     ``fleet`` is the batched pytree from ``stack_members``. Returns
     ``(final_states, logs)`` where every leaf carries a leading fleet
     axis: states are ``[F, ...]``, logs are member-major ``[F, T, ...]``.
-    The tick body compiles once per (shape, settings) — re-dispatching
-    with fresh scenarios of the same shape is compile-free.
+    With ``settings.flight_recorder_window > 0`` the result grows to
+    ``(final_states, logs, recorders)`` — one ``[F, W, G]`` gauge ring
+    plus per-member stamps (``rapid_tpu.engine.recorder``). The tick
+    body compiles once per (shape, settings) — re-dispatching with
+    fresh scenarios of the same shape is compile-free.
 
     ``mesh`` (static) shards every member's slot axis over the device
     mesh while the fleet axis stays replicated (``P(None, 'slots')`` on

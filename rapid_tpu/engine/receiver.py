@@ -84,6 +84,7 @@ from jax import lax
 
 from rapid_tpu import hashing
 from rapid_tpu.engine import cut, monitor
+from rapid_tpu.engine import recorder as recorder_mod
 from rapid_tpu.engine import sharding as sharding_mod
 from rapid_tpu.engine.state import (
     I32_MAX, EngineFaults, ReceiverState, ReceiverStepLog, config_id_limbs)
@@ -910,6 +911,23 @@ def init_receiver_state(uids: Sequence[int], id_fp_sum: int,
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _simulate(rs, faults, n_ticks: int, settings: Settings):
+    # Static flight-recorder gate (``engine.recorder``, same discipline
+    # as step._simulate): W > 0 threads a bounded gauge ring through the
+    # scan and returns a 3-tuple; W == 0 keeps the recorder-less scan
+    # verbatim so its jaxpr is byte-identical. Module-attribute call so
+    # tests can monkeypatch a spy on the record hook.
+    if settings.flight_recorder_window:
+        def rec_body(carry, _):
+            st, rec = carry
+            nxt, log = receiver_step(st, faults, settings)
+            return (nxt, recorder_mod.record_receiver_step(
+                rec, log, settings)), log
+
+        (final, rec), logs = lax.scan(
+            rec_body, (rs, recorder_mod.init(settings)), None,
+            length=n_ticks)
+        return final, logs, rec
+
     def body(carry, _):
         return receiver_step(carry, faults, settings)
 
@@ -918,7 +936,9 @@ def _simulate(rs, faults, n_ticks: int, settings: Settings):
 
 def receiver_simulate(rs: ReceiverState, faults: EngineFaults,
                       n_ticks: int, settings: Settings):
-    """Run the jitted per-receiver scan; returns (final_state, logs)."""
+    """Run the jitted per-receiver scan; returns (final_state, logs) —
+    or (final_state, logs, recorder) when
+    ``settings.flight_recorder_window > 0``."""
     return _simulate(rs, faults, n_ticks, settings)
 
 
@@ -933,6 +953,17 @@ def _fleet_body(rs, faults, n_ticks: int, settings: Settings,
         rs = sharding_mod.fleet_axis_constrain_tree(rs, fleet_mesh, f)
         faults = sharding_mod.fleet_axis_constrain_tree(
             faults, fleet_mesh, f)
+    if settings.flight_recorder_window:
+        finals, logs, recs = jax.vmap(
+            lambda s, f_: _simulate(s, f_, n_ticks, settings))(rs, faults)
+        if fleet_mesh is not None:
+            finals = sharding_mod.fleet_axis_constrain_tree(
+                finals, fleet_mesh, f)
+            logs = sharding_mod.fleet_axis_constrain_tree(
+                logs, fleet_mesh, f)
+            recs = sharding_mod.fleet_axis_constrain_tree(
+                recs, fleet_mesh, f)
+        return finals, logs, recs
     finals, logs = jax.vmap(
         lambda s, f_: _simulate(s, f_, n_ticks, settings))(rs, faults)
     if fleet_mesh is not None:

@@ -1,0 +1,212 @@
+"""On-device flight recorder: a bounded gauge ring in the jitted scan.
+
+Campaign folds collapse thousands of clusters into percentiles, so by
+the time the host learns a member is anomalous (never decided, tripped
+an invariant, left the envelope) its per-tick history is gone — the
+fleet scan keeps full ``StepLog`` columns on device, but shipping
+``[F, T, ...]`` logs to the host for 100k members is exactly the
+transfer the campaign driver exists to avoid. The recorder is the
+middle ground: a static-size ``[W, G]`` ring of small per-tick gauges
+(W = ``Settings.flight_recorder_window``) plus first-occurrence tick
+stamps, carried through ``lax.scan`` alongside the engine state, cheap
+enough to keep for *every* member and only pulled to the host for the
+members the triage classifier flags (``campaign.py``).
+
+Zero-overhead discipline (mirrors ``engine.invariants``): the window is
+a *static* settings field; ``W == 0`` (the default) compiles the
+recorder out entirely — the scan bodies in ``engine.step`` and
+``engine.receiver`` keep their recorder-less code verbatim, so the
+disabled jaxpr is byte-identical to a build without this module. Both
+scan bodies reach the recorder through module attributes
+(``recorder.record_step`` / ``recorder.record_receiver_step``) so tests
+can monkeypatch a spy and prove the disabled path never calls in.
+
+Gauge schema
+------------
+One shared ``GAUGE_NAMES`` row schema covers both kernels; gauges a
+kernel does not observe hold ``UNOBSERVED`` (-1) so a triage consumer
+can mix shared-state and per-receiver rings without per-kind schemas.
+The ring holds the *last* W ticks (write position ``count % W``);
+:func:`ring_rows` restores chronological order on the host.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rapid_tpu.settings import Settings
+
+#: Value recorded for gauges the emitting kernel does not observe.
+UNOBSERVED = -1
+
+#: One row of the ring, in column order. The shared-state step fills
+#: the protocol/engine gauges; the per-receiver step fills the exact
+#: wire counters and the sticky flags word. ``announces``/``decides``
+#: are counts (0/1 for the shared step, per-slot sums for receiver).
+GAUGE_NAMES = (
+    "tick",
+    "n_member",
+    "alerts_in_flight",
+    "cut_reports",
+    "vote_tally",
+    "epoch",
+    "px_timers_armed",
+    "px_coord_round",
+    "inv_bits",
+    "announces",
+    "decides",
+    "sent",
+    "delivered",
+    "dropped",
+    "flags",
+)
+
+N_GAUGES = len(GAUGE_NAMES)
+
+
+class RecorderState(NamedTuple):
+    """The extra scan carry; every leaf is i32 so fleet stacking is a
+    plain vmap axis. Stamps are -1 until the event first occurs."""
+
+    ring: object             # i32 [W, G] last-W gauge rows, ring order
+    count: object            # i32 ticks recorded (write pos = count % W)
+    first_announce: object   # i32 first tick any proposal was announced
+    first_decide: object     # i32 first tick a view change decided
+    first_fallback: object   # i32 first tick classic-Paxos traffic moved
+    first_violation: object  # i32 first tick inv_bits/flags went nonzero
+
+
+def init(settings: Settings) -> RecorderState:
+    """Fresh recorder for one member. Only valid when the static window
+    is nonzero — the W == 0 path must never construct a recorder."""
+    w = int(settings.flight_recorder_window)
+    if w <= 0:
+        raise ValueError("recorder.init called with flight_recorder_window=0")
+    neg = jnp.int32(-1)
+    return RecorderState(
+        ring=jnp.full((w, N_GAUGES), UNOBSERVED, jnp.int32),
+        count=jnp.int32(0),
+        first_announce=neg,
+        first_decide=neg,
+        first_fallback=neg,
+        first_violation=neg,
+    )
+
+
+def _push(rec: RecorderState, row, tick, announced, decided, fallback,
+          violated) -> RecorderState:
+    """Write one gauge row at ``count % W`` and fold the stamps."""
+    w = rec.ring.shape[0]
+    pos = lax.rem(rec.count, jnp.int32(w))
+    ring = lax.dynamic_update_slice(rec.ring, row[None, :],
+                                    (pos, jnp.int32(0)))
+    t = tick.astype(jnp.int32)
+    stamp = lambda old, cond: jnp.where((old < 0) & cond, t, old)
+    return RecorderState(
+        ring=ring,
+        count=rec.count + 1,
+        first_announce=stamp(rec.first_announce, announced),
+        first_decide=stamp(rec.first_decide, decided),
+        first_fallback=stamp(rec.first_fallback, fallback),
+        first_violation=stamp(rec.first_violation, violated),
+    )
+
+
+def record_step(rec: RecorderState, log, settings: Settings
+                ) -> RecorderState:
+    """Fold one shared-state ``StepLog`` tick into the recorder."""
+    i32 = lambda x: jnp.asarray(x).astype(jnp.int32)
+    un = jnp.int32(UNOBSERVED)
+    announced = jnp.asarray(log.announce_now, bool)
+    decided = jnp.asarray(log.decide_now, bool)
+    fallback = (i32(log.pxvote_senders) + i32(log.px1a_senders)
+                + i32(log.px1b_senders) + i32(log.px2a_senders)
+                + i32(log.px2b_senders)) > 0
+    violated = i32(log.inv_bits) != 0
+    row = jnp.stack([
+        i32(log.tick),
+        i32(log.n_member),
+        i32(log.alerts_in_flight),
+        i32(log.cut_reports),
+        i32(log.vote_tally),
+        i32(log.epoch),
+        i32(log.px_timers_armed),
+        i32(log.px_coord_round),
+        i32(log.inv_bits),
+        announced.astype(jnp.int32),
+        decided.astype(jnp.int32),
+        un, un, un, un,          # sent / delivered / dropped / flags
+    ])
+    return _push(rec, row, log.tick, announced, decided, fallback, violated)
+
+
+def record_receiver_step(rec: RecorderState, log, settings: Settings
+                         ) -> RecorderState:
+    """Fold one ``ReceiverStepLog`` tick into the recorder."""
+    i32 = lambda x: jnp.asarray(x).astype(jnp.int32)
+    un = jnp.int32(UNOBSERVED)
+    announced = jnp.asarray(log.announce, bool).any()
+    decided = jnp.asarray(log.decide, bool).any()
+    fallback = (i32(log.p1a_sent) + i32(log.p1b_sent)
+                + i32(log.p2a_sent) + i32(log.p2b_sent)) > 0
+    violated = i32(log.flags) != 0
+    row = jnp.stack([
+        i32(log.tick),
+        un, un, un, un, un, un, un, un,   # shared-engine-only gauges
+        jnp.asarray(log.announce, bool).sum().astype(jnp.int32),
+        jnp.asarray(log.decide, bool).sum().astype(jnp.int32),
+        i32(log.sent),
+        i32(log.delivered),
+        i32(log.dropped),
+        i32(log.flags),
+    ])
+    return _push(rec, row, log.tick, announced, decided, fallback, violated)
+
+
+# --- host-side extraction ------------------------------------------------
+
+def member_recorder(recs: RecorderState, i: int) -> RecorderState:
+    """Slice member ``i`` out of a fleet-stacked recorder pytree."""
+    return jax.tree_util.tree_map(lambda x: x[i], recs)
+
+
+def ring_rows(rec: RecorderState) -> np.ndarray:
+    """The recorded rows in chronological order, ``[min(count, W), G]``
+    (partial fills return only the written prefix; full rings unroll the
+    wrap so row 0 is the oldest retained tick)."""
+    ring = np.asarray(rec.ring)
+    count = int(np.asarray(rec.count))
+    w = ring.shape[0]
+    if count <= w:
+        return ring[:count]
+    pos = count % w
+    return np.concatenate([ring[pos:], ring[:pos]], axis=0)
+
+
+def stamps(rec: RecorderState) -> dict:
+    """First-occurrence tick stamps as python ints (-1 = never)."""
+    return {
+        "first_announce": int(np.asarray(rec.first_announce)),
+        "first_decide": int(np.asarray(rec.first_decide)),
+        "first_fallback": int(np.asarray(rec.first_fallback)),
+        "first_violation": int(np.asarray(rec.first_violation)),
+    }
+
+
+def recorder_payload(rec: RecorderState) -> dict:
+    """JSON-ready block for one member's recorder (the form embedded in
+    ``campaign.triage`` exemplars and validated by
+    ``telemetry.schema.FLIGHT_RECORDER_SPEC``)."""
+    rows = ring_rows(rec)
+    return {
+        "window": int(np.asarray(rec.ring).shape[0]),
+        "gauges": list(GAUGE_NAMES),
+        "ticks_recorded": int(np.asarray(rec.count)),
+        "rows": [[int(v) for v in row] for row in rows],
+        "stamps": stamps(rec),
+    }

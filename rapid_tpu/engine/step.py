@@ -56,6 +56,7 @@ from rapid_tpu import hashing
 from rapid_tpu.engine import cut, invariants, monitor
 from rapid_tpu.engine import churn as churn_mod
 from rapid_tpu.engine import paxos as paxos_mod
+from rapid_tpu.engine import recorder as recorder_mod
 from rapid_tpu.engine import sharding as sharding_mod
 from rapid_tpu.engine import votes as votes_mod
 from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
@@ -422,6 +423,23 @@ def _simulate(state, faults, n_ticks: int, settings: Settings, churn=None,
         state = sharding_mod.constrain_tree(state, mesh, c)
         faults = sharding_mod.constrain_tree(faults, mesh, c)
 
+    # Static recorder gate (``engine.recorder``): W > 0 threads a
+    # bounded gauge ring through the scan as an extra carry and returns
+    # a 3-tuple; the W == 0 branch keeps the recorder-less scan verbatim
+    # so its jaxpr is byte-identical to a build without the recorder.
+    # Module-attribute calls so tests can monkeypatch a spy (same
+    # discipline as the invariant monitor above).
+    if settings.flight_recorder_window:
+        def rec_body(carry, _):
+            st, rec = carry
+            nxt, log = step(st, faults, settings, churn, fallback, mesh)
+            return (nxt, recorder_mod.record_step(rec, log, settings)), log
+
+        (final, rec), logs = lax.scan(
+            rec_body, (state, recorder_mod.init(settings)), None,
+            length=n_ticks)
+        return final, logs, rec
+
     def body(carry, _):
         return step(carry, faults, settings, churn, fallback, mesh)
 
@@ -441,6 +459,9 @@ def simulate(state: EngineState, faults: EngineFaults, n_ticks: int,
     (``rapid_tpu.engine.sharding.slot_mesh``): the scan carry stays
     partitioned over the slot axis across all ticks, and results are
     bit-identical to the unsharded run.
+
+    With ``settings.flight_recorder_window > 0`` the return grows to
+    ``(final_state, logs, recorder)`` — see ``rapid_tpu.engine.recorder``.
     """
     return _simulate(state, faults, int(n_ticks), settings, churn, fallback,
                      mesh)
@@ -503,6 +524,35 @@ def fleet_body(states, faults, churn, fallback, n_ticks: int,
             churn, fleet_mesh, f)
         fallback = sharding_mod.fleet_axis_constrain_tree(
             fallback, fleet_mesh, f)
+
+    # Same static recorder gate as ``_simulate``: W > 0 carries a
+    # per-member gauge ring through each scan (one extra vmapped carry,
+    # [F, W, G] total) and the fleet result grows to a 3-tuple; W == 0
+    # keeps the recorder-less body verbatim (byte-identical jaxpr).
+    if settings.flight_recorder_window:
+        def one_rec(state, member_faults, member_churn, member_fallback):
+            def rec_body(carry, _):
+                st, rec = carry
+                nxt, log = step(st, member_faults, settings, member_churn,
+                                member_fallback, mesh)
+                return (nxt,
+                        recorder_mod.record_step(rec, log, settings)), log
+
+            (final, rec), logs = lax.scan(
+                rec_body, (state, recorder_mod.init(settings)), None,
+                length=n_ticks)
+            return final, logs, rec
+
+        finals, logs, recs = jax.vmap(one_rec)(states, faults, churn,
+                                               fallback)
+        if fleet_mesh is not None:
+            finals = sharding_mod.fleet_axis_constrain_tree(
+                finals, fleet_mesh, f)
+            logs = sharding_mod.fleet_axis_constrain_tree(
+                logs, fleet_mesh, f)
+            recs = sharding_mod.fleet_axis_constrain_tree(
+                recs, fleet_mesh, f)
+        return finals, logs, recs
 
     def one(state, member_faults, member_churn, member_fallback):
         def body(carry, _):

@@ -1,0 +1,324 @@
+"""Deterministic single-member replay of a campaign anomaly.
+
+``campaign.py`` folds thousands of clusters into percentiles; the
+``triage`` block names the anomalous members as ``(dispatch,
+member_index)`` refs. This tool closes the loop: given only a campaign
+payload, it reconstructs the *exact* sampled schedule of one member
+from the campaign seed (the sampling chain, dispatch pools, and chunk
+plan are all bit-deterministic in ``CampaignConfig``), re-runs that one
+cluster unbatched — stacked to its pool's program shape so the padded
+member program is reproduced bit-for-bit, fleet axis of one — and
+emits everything the in-fleet fold threw away: full per-tick
+``TickMetrics`` (``--metrics`` JSONL), a Perfetto trace of the
+protocol's virtual time (``--trace``), the member's flight-recorder
+ring when the campaign ran with one, and an optional host oracle
+differential (``--oracle``, with ``--forensics`` naming the divergence
+JSONL).
+
+When the member is a triage exemplar, the replay is *verified*: every
+field of the exemplar's ``expected`` block — decide ticks, config ids,
+counter folds, fallback phase totals, sticky flags — must match the
+fresh fold bit-for-bit (exit 1 on any mismatch), proving the replay is
+the member the fleet ran, not a lookalike.
+
+CLI::
+
+    python -m rapid_tpu.replay --payload CAMPAIGN.json --member 3:17 \
+        --metrics member.jsonl --trace member_trace.json --oracle
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+__all__ = ["replay_member", "main"]
+
+
+def _find_exemplar(payload: Dict[str, object], dispatch: int,
+                   member_index: int) -> Tuple[Optional[str],
+                                               Optional[Dict[str, object]]]:
+    """Locate the triage exemplar for (dispatch, member_index), if the
+    campaign flagged this member; returns (class_name, exemplar)."""
+    triage = (payload.get("campaign") or {}).get("triage") or {}
+    for name, block in (triage.get("classes") or {}).items():
+        for ex in block.get("exemplars", ()):
+            if (ex.get("dispatch") == dispatch
+                    and ex.get("member_index") == member_index
+                    and ex.get("expected") is not None):
+                return name, ex
+    return None, None
+
+
+def _diff_blocks(expected: Dict[str, object], replayed: Dict[str, object]
+                 ) -> Dict[str, Dict[str, object]]:
+    """Field-by-field mismatches between the exemplar's expected block
+    and the fresh fold ({} == bit-identical)."""
+    out: Dict[str, Dict[str, object]] = {}
+    for key in sorted(set(expected) | set(replayed)):
+        if expected.get(key) != replayed.get(key):
+            out[key] = {"expected": expected.get(key),
+                        "replayed": replayed.get(key)}
+    return out
+
+
+def replay_member(payload: Dict[str, object], dispatch: int,
+                  member_index: int, *, oracle: bool = False,
+                  metrics_path: Optional[str] = None,
+                  trace_path: Optional[str] = None,
+                  forensics_path: Optional[str] = None
+                  ) -> Dict[str, object]:
+    """Re-run one campaign member from the payload's campaign block.
+
+    Returns the replay record: member identity (global campaign index,
+    kind, mode, seed), the freshly folded ``replayed`` block in the
+    exemplar ``expected`` format, the recorder payload (when the
+    campaign carried a flight recorder), the exemplar match verdict
+    (``match`` is None when the member was not flagged), and the oracle
+    differential result when requested.
+    """
+    import jax
+
+    from rapid_tpu import campaign as campaign_mod
+    from rapid_tpu.engine import receiver as receiver_mod
+    from rapid_tpu.engine import recorder as recorder_mod
+    from rapid_tpu.engine.fleet import (fleet_simulate,
+                                        lower_receiver_schedule,
+                                        receiver_fleet_simulate,
+                                        stack_members,
+                                        stack_receiver_members)
+    from rapid_tpu.faults import ScenarioWeights
+    from rapid_tpu.settings import Settings
+    from rapid_tpu.telemetry import metrics as metrics_mod
+    from rapid_tpu.telemetry.trace import TraceWriter, trace_from_logs
+
+    camp = payload.get("campaign")
+    if not camp:
+        raise ValueError("payload has no campaign block — replay needs a "
+                         "rapid_tpu.campaign artifact")
+    for key in ("seed", "clusters", "n", "ticks", "headroom", "weights",
+                "fleet_size"):
+        if key not in camp:
+            raise ValueError(
+                f"campaign block lacks {key!r} — replay needs a "
+                "schema >= 8 payload (re-run the campaign on this tree)")
+    cfg = campaign_mod.CampaignConfig(
+        clusters=camp["clusters"], n=camp["n"], ticks=camp["ticks"],
+        seed=camp["seed"], fleet_size=camp["fleet_size"],
+        headroom=camp["headroom"],
+        weights=ScenarioWeights(**camp["weights"]),
+        per_receiver=camp["per_receiver"]["enabled"],
+        flight_recorder=int(camp.get("flight_recorder") or 0))
+
+    # The deterministic chain, replayed verbatim from run_campaign:
+    # sample -> route -> pools -> chunk plan. Same seed, same plan.
+    base = Settings()
+    c = cfg.n + cfg.headroom
+    settings = base.with_(capacity=c)
+    rx_settings = base.with_(capacity=cfg.n)
+    if cfg.flight_recorder:
+        settings = settings.with_(flight_recorder_window=cfg.flight_recorder)
+        rx_settings = rx_settings.with_(
+            flight_recorder_window=cfg.flight_recorder)
+    f = max(1, cfg.fleet_size)
+    total = -(-cfg.clusters // f) * f
+    scenarios = [campaign_mod._sample_scenario(cfg, i)
+                 for i in range(total)]
+    rx_idx = [i for i, sc in enumerate(scenarios)
+              if (cfg.per_receiver and campaign_mod._receiver_eligible(sc))
+              or campaign_mod._delay_member(sc)]
+    sh_idx = [i for i in range(total) if i not in set(rx_idx)]
+    pools = campaign_mod._build_pools(scenarios, sh_idx, rx_idx, f)
+    plan = [(pool, chunk) for pool in pools
+            for chunk in campaign_mod._chunks(pool["members"],
+                                              pool["fleet_size"])]
+    if not (0 <= dispatch < len(plan)):
+        raise ValueError(f"dispatch {dispatch} out of range: the plan has "
+                         f"{len(plan)} dispatches")
+    pool, chunk = plan[dispatch]
+    if not (0 <= member_index < len(chunk)):
+        raise ValueError(
+            f"member_index {member_index} out of range: dispatch "
+            f"{dispatch} carries {len(chunk)} real members (padded slots "
+            "are cycled copies and have no campaign identity)")
+    i = chunk[member_index]
+    sc = scenarios[i]
+    mode, shape = pool["mode"], pool["shape"]
+
+    # One-member fleet stacked to the pool maxima: the member's padded
+    # program — window rows, fallback tables, delay-rule planes — is
+    # the one the campaign dispatch ran, so the fold is bit-identical,
+    # not merely equivalent.
+    writer = TraceWriter() if trace_path else None
+    rec = None
+    if mode == "shared":
+        member = campaign_mod._lower_shared(cfg, settings, i, sc)
+        fleet = stack_members([member], n_windows=shape[0],
+                              n_instances=shape[1], n_pids=shape[2])
+        result = fleet_simulate(fleet, cfg.ticks, settings)
+        if cfg.flight_recorder:
+            finals, logs, recs = result
+            rec = recorder_mod.member_recorder(recs, 0)
+        else:
+            finals, logs = result
+        jax.block_until_ready(logs)
+        summary = metrics_mod.fleet_summaries(logs)[0]
+        mlog = jax.tree_util.tree_map(lambda x: x[0], logs)
+        rows = metrics_mod.engine_metrics(mlog)
+        import numpy as np
+        cid = (int(np.asarray(mlog.config_hi)[-1]) << 32
+               | int(np.asarray(mlog.config_lo)[-1]))
+        meta = {"flags": 0, "config_ids": [f"{cid:016x}"]}
+        if writer is not None:
+            trace_from_logs(mlog, settings, writer=writer)
+    else:
+        member = lower_receiver_schedule(sc.schedule, rx_settings,
+                                         fleet_size=1)
+        fleet = stack_receiver_members([member], n_windows=shape[0],
+                                       n_delay_rules=shape[1])
+        result = receiver_fleet_simulate(fleet, cfg.ticks, rx_settings)
+        if cfg.flight_recorder:
+            finals, logs, recs = result
+            rec = recorder_mod.member_recorder(recs, 0)
+        else:
+            finals, logs = result
+        jax.block_until_ready(logs)
+        import numpy as np
+        mrs = jax.tree_util.tree_map(lambda x: x[0], finals)
+        mlog = jax.tree_util.tree_map(lambda x: x[0], logs)
+        run = receiver_mod.receiver_run_payload(mrs, mlog, cfg.n,
+                                                cfg.ticks)
+        rows = run.metrics()
+        summary = metrics_mod.summarize(rows)
+        cids = sorted(set(receiver_mod.receiver_config_ids(mrs)[:cfg.n]))
+        meta = {"flags": int(np.asarray(mrs.flags)),
+                "config_ids": [f"{x:016x}" for x in cids]}
+
+    replayed = campaign_mod._expected_block(summary, meta)
+    recorder_payload = (recorder_mod.recorder_payload(rec)
+                        if rec is not None else None)
+
+    if metrics_path:
+        metrics_mod.write_jsonl(rows, metrics_path)
+    if writer is not None:
+        writer.write(trace_path)
+
+    cls, exemplar = _find_exemplar(payload, dispatch, member_index)
+    mismatches = None
+    recorder_match = None
+    if exemplar is not None:
+        mismatches = _diff_blocks(exemplar["expected"], replayed)
+        if exemplar.get("recorder") is not None \
+                and recorder_payload is not None:
+            recorder_match = exemplar["recorder"] == recorder_payload
+
+    oracle_block = None
+    if oracle:
+        oracle_block = {"run": False, "passed": None, "error": None,
+                        "artifact": None}
+        if sc.wants_churn:
+            oracle_block["error"] = ("oracle referee replays fault "
+                                     "surfaces only; churn members are "
+                                     "ineligible")
+        else:
+            from rapid_tpu.engine.diff import (
+                run_adversarial_differential, run_receiver_differential)
+            from rapid_tpu.telemetry.forensics import DivergenceError
+
+            referee_settings = base.with_(capacity=0)
+            runner = run_receiver_differential if mode == "per_receiver" \
+                else run_adversarial_differential
+            oracle_block["run"] = True
+            try:
+                res = runner(sc.schedule, cfg.ticks, referee_settings)
+                res.assert_identical(artifact=forensics_path)
+                oracle_block["passed"] = True
+            except (DivergenceError,
+                    receiver_mod.ReceiverEnvelopeError) as err:
+                oracle_block["passed"] = False
+                oracle_block["error"] = str(err).splitlines()[0]
+                oracle_block["artifact"] = forensics_path
+
+    return {
+        "record": "replay",
+        "dispatch": dispatch,
+        "member_index": member_index,
+        "member": i,
+        "kind": sc.kind,
+        "mode": mode,
+        "seed": campaign_mod._member_seed(cfg, i),
+        "ticks": cfg.ticks,
+        "n": cfg.n,
+        "replayed": replayed,
+        "recorder": recorder_payload,
+        "triage_class": cls,
+        "match": (not mismatches) if mismatches is not None else None,
+        "mismatches": mismatches or None,
+        "recorder_match": recorder_match,
+        "oracle": oracle_block,
+    }
+
+
+def _parse_member(text: str) -> Tuple[int, int]:
+    d, _, j = text.partition(":")
+    try:
+        return int(d), int(j)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--member wants DISPATCH:MEMBER_INDEX (e.g. 3:17), got "
+            f"{text!r}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Replay one campaign member deterministically from "
+                    "its payload (see rapid_tpu/replay.py docstring)")
+    parser.add_argument("--payload", required=True, metavar="FILE",
+                        help="campaign JSON artifact (schema >= 8, "
+                             "written by python -m rapid_tpu.campaign "
+                             "--out)")
+    parser.add_argument("--member", required=True, type=_parse_member,
+                        metavar="D:I",
+                        help="dispatch index and member index within "
+                             "that dispatch, as shown in triage "
+                             "exemplar refs")
+    parser.add_argument("--metrics", type=str, default=None, metavar="FILE",
+                        help="write the member's full per-tick "
+                             "TickMetrics stream as JSONL")
+    parser.add_argument("--trace", type=str, default=None, metavar="FILE",
+                        help="write a Perfetto trace of the member's "
+                             "protocol virtual time (shared-state "
+                             "members only)")
+    parser.add_argument("--forensics", type=str, default=None,
+                        metavar="FILE",
+                        help="divergence JSONL artifact path for "
+                             "--oracle (written only on divergence)")
+    parser.add_argument("--oracle", action="store_true",
+                        help="also replay the schedule through the host "
+                             "oracle referee and report the differential")
+    parser.add_argument("--out", type=str, default=None, metavar="FILE",
+                        help="write the replay record JSON here too")
+    args = parser.parse_args(argv)
+
+    with open(args.payload) as fh:
+        payload = json.load(fh)
+    dispatch, member_index = args.member
+    record = replay_member(payload, dispatch, member_index,
+                           oracle=args.oracle,
+                           metrics_path=args.metrics,
+                           trace_path=args.trace,
+                           forensics_path=args.forensics)
+    if args.out:
+        from rapid_tpu.telemetry import write_json_artifact
+
+        write_json_artifact(args.out, record, indent=2)
+    print(json.dumps(record), flush=True)
+    failed = (record["match"] is False
+              or record["recorder_match"] is False
+              or (record["oracle"] or {}).get("passed") is False)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

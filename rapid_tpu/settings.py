@@ -76,6 +76,15 @@ class Settings:
     # entirely, so the production step pays nothing for them.
     invariant_checks: bool = False
 
+    # --- observability (rapid_tpu.engine.recorder) ---
+    # Window W of the on-device flight recorder: a bounded [W, G] ring of
+    # per-tick protocol gauges plus first-occurrence tick stamps carried
+    # through the jitted scan as an extra carry (see
+    # ``rapid_tpu.engine.recorder``). Static: 0 (the default) compiles
+    # the recorder out entirely — the scan body is byte-identical to the
+    # recorder-less jaxpr, same discipline as ``invariant_checks``.
+    flight_recorder_window: int = 0
+
     # --- randomness ---
     seed: int = 0
 
@@ -89,6 +98,10 @@ class Settings:
             raise ValueError(
                 f"delivery_ring_depth must be >= 1, got "
                 f"{self.delivery_ring_depth}")
+        if self.flight_recorder_window < 0:
+            raise ValueError(
+                f"flight_recorder_window must be >= 0, got "
+                f"{self.flight_recorder_window}")
 
     def with_(self, **kw) -> "Settings":
         return replace(self, **kw)

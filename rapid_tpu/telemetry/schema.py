@@ -25,7 +25,7 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 #: Fold semantics of every RunSummary gauge when aggregated over a fleet
 #: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
@@ -173,10 +173,20 @@ RECEIVER_FLEET_ENTRY_SPEC = {
 
 
 #: Fleet-campaign block embedded in a fleet run payload under
-#: ``"campaign"`` (``rapid_tpu.campaign.run_campaign``).
+#: ``"campaign"`` (``rapid_tpu.campaign.run_campaign``). Schema v8 adds
+#: the replay identity (``n``/``ticks``/``headroom``/``weights``/
+#: ``flight_recorder`` — together with ``seed``/``clusters``/
+#: ``fleet_size``/``per_receiver.enabled`` they reconstruct every
+#: sampled schedule and the dispatch plan bit-exactly, which is what
+#: ``python -m rapid_tpu.replay`` consumes) and the ``triage`` block.
 CAMPAIGN_SPEC = {
     "seed": (int,),
     "clusters": (int,),
+    "n": (int,),
+    "ticks": (int,),
+    "headroom": (int,),
+    "weights": (dict,),
+    "flight_recorder": (int,),
     "fleet_size": (int,),
     "dispatches": (int,),
     "scenario_kinds": (dict,),
@@ -185,6 +195,83 @@ CAMPAIGN_SPEC = {
     "spot_checks": (dict,),
     "distributions": (dict,),
     "delay_regimes": (dict,),
+    "triage": (dict,),
+}
+
+#: Anomaly classes of the campaign triage block (schema v8), in the
+#: order ``campaign._triage`` reports them. Every class key must be
+#: present in ``triage.classes`` even when its count is zero — absence
+#: would be indistinguishable from "classifier never ran".
+TRIAGE_CLASSES = ("no_decide_by_deadline", "slow_decide",
+                  "invariant_violations", "envelope_flags",
+                  "excess_fallback", "spot_failures")
+
+#: Top-level ``campaign.triage`` block (schema v8). Every value is a
+#: seed-deterministic fold — no wall-clock fields — so bench_compare's
+#: exact campaign diff gates the whole block. ``recorder`` is null when
+#: the campaign ran without ``--flight-recorder``.
+TRIAGE_SPEC = {
+    "clusters": (int,),
+    "flagged_members": (int,),
+    "thresholds": (dict,),
+    "recorder": (dict, type(None)),
+    "classes": (dict,),
+}
+
+#: One anomaly class: total flagged members, per-scenario-kind counts,
+#: and up to ``campaign.MAX_TRIAGE_EXEMPLARS`` exemplar refs.
+TRIAGE_CLASS_SPEC = {
+    "count": (int,),
+    "by_kind": (dict,),
+    "exemplars": (list,),
+}
+
+#: One triage exemplar: the ``(dispatch, member_index)`` ref is the
+#: ``--member D:I`` handle ``rapid_tpu.replay`` takes; ``expected`` is
+#: the bit-identity contract the replay must reproduce (null only for
+#: forced spot-check schedules that never ran in the fleet, ref
+#: ``(-1, -1)``); ``recorder`` is the member's extracted flight-recorder
+#: ring (null when the campaign ran without one).
+TRIAGE_EXEMPLAR_SPEC = {
+    "dispatch": (int,),
+    "member_index": (int,),
+    "member": (int,),
+    "kind": (str,),
+    "mode": (str,),
+    "seed": (int,),
+    "expected": (dict, type(None)),
+    "recorder": (dict, type(None)),
+}
+
+#: The exemplar ``expected`` block (``campaign._expected_block``): the
+#: per-member fold fields a replay must match bit-for-bit.
+TRIAGE_EXPECTED_SPEC = {
+    "ticks_to_first_announce": _OPT_INT,
+    "ticks_to_first_decide": _OPT_INT,
+    "announcements": (int,),
+    "decisions": (int,),
+    "invariant_violations": (int,),
+    "counter_totals": (dict,),
+    "fallback_phase_sent": (dict,),
+    "config_ids": (list,),
+    "flags": (int,),
+}
+
+#: First-occurrence tick stamps of a flight-recorder payload (-1 ==
+#: never observed inside the run).
+RECORDER_STAMPS = ("first_announce", "first_decide", "first_fallback",
+                   "first_violation")
+
+#: One extracted flight-recorder ring
+#: (``engine.recorder.recorder_payload``): the last ``window`` per-tick
+#: gauge rows in chronological order (row length == len(gauges), -1 ==
+#: gauge unobserved by that kernel) plus the first-occurrence stamps.
+FLIGHT_RECORDER_SPEC = {
+    "window": (int,),
+    "gauges": (list,),
+    "ticks_recorded": (int,),
+    "rows": (list,),
+    "stamps": (dict,),
 }
 
 #: One kind-homogeneous dispatch pool of a campaign plan (schema v7):
@@ -355,7 +442,10 @@ PIPELINE_SPEC = {
 
 #: One ``record: "dispatch"`` heartbeat line of a ``--progress`` JSONL
 #: stream (schema v7 adds the pool identity and the live pipeline
-#: depth *after* this dispatch retired).
+#: depth *after* this dispatch retired; schema v8 adds ``anomalies`` —
+#: the running per-class anomaly counts over the members retired so
+#: far, so a long campaign's heartbeats show trouble as it accumulates,
+#: not at the final fold).
 PROGRESS_DISPATCH_SPEC = {
     "record": (str,),
     "index": (int,),
@@ -367,6 +457,7 @@ PROGRESS_DISPATCH_SPEC = {
     "clusters_total": (int,),
     "stages": (dict,),
     "spot_failures": (int,),
+    "anomalies": (dict,),
 }
 
 #: Relative slack allowed between a campaign payload's ``wall_s`` and
@@ -407,6 +498,67 @@ def validate_telemetry(block, where: str = "telemetry") -> List[str]:
             errors += _check(
                 px, {phase: (int,) for phase in FALLBACK_PHASES},
                 f"{where}.fallback_phase_sent")
+    return errors
+
+
+def validate_flight_recorder(block, where: str = "recorder") -> List[str]:
+    """Validate one extracted flight-recorder ring payload."""
+    errors = _check(block, FLIGHT_RECORDER_SPEC, where)
+    if not isinstance(block, dict):
+        return errors
+    gauges = block.get("gauges")
+    n_gauges = len(gauges) if isinstance(gauges, list) else None
+    rows = block.get("rows")
+    if isinstance(rows, list):
+        window = block.get("window")
+        if isinstance(window, int) and not isinstance(window, bool) \
+                and len(rows) > window:
+            errors.append(f"{where}.rows: {len(rows)} rows exceed "
+                          f"window={window}")
+        for i, row in enumerate(rows):
+            if not isinstance(row, list):
+                errors.append(f"{where}.rows[{i}]: expected list, "
+                              f"got {type(row).__name__}")
+            elif n_gauges is not None and len(row) != n_gauges:
+                errors.append(f"{where}.rows[{i}]: {len(row)} values for "
+                              f"{n_gauges} gauges")
+    stamps = block.get("stamps")
+    if isinstance(stamps, dict):
+        errors += _check(stamps, {s: (int,) for s in RECORDER_STAMPS},
+                         f"{where}.stamps")
+    return errors
+
+
+def validate_triage(block, where: str = "triage") -> List[str]:
+    """Validate a campaign ``triage`` block (schema v8)."""
+    errors = _check(block, TRIAGE_SPEC, where)
+    if not isinstance(block, dict):
+        return errors
+    classes = block.get("classes")
+    if not isinstance(classes, dict):
+        return errors
+    for name in TRIAGE_CLASSES:
+        if name not in classes:
+            errors.append(f"{where}.classes.{name}: missing")
+    for name, cls in classes.items():
+        cw = f"{where}.classes.{name}"
+        if name not in TRIAGE_CLASSES:
+            errors.append(f"{cw}: unknown class (expected one of "
+                          f"{'/'.join(TRIAGE_CLASSES)})")
+        errors += _check(cls, TRIAGE_CLASS_SPEC, cw)
+        if not isinstance(cls, dict):
+            continue
+        for i, ex in enumerate(cls.get("exemplars") or []):
+            ew = f"{cw}.exemplars[{i}]"
+            errors += _check(ex, TRIAGE_EXEMPLAR_SPEC, ew)
+            if not isinstance(ex, dict):
+                continue
+            if isinstance(ex.get("expected"), dict):
+                errors += _check(ex["expected"], TRIAGE_EXPECTED_SPEC,
+                                 f"{ew}.expected")
+            if isinstance(ex.get("recorder"), dict):
+                errors += validate_flight_recorder(ex["recorder"],
+                                                   f"{ew}.recorder")
     return errors
 
 
@@ -463,6 +615,8 @@ def validate_campaign(block, where: str = "campaign") -> List[str]:
                               f"{'/'.join(DELAY_REGIMES)})")
             errors += _check(dist, DISTRIBUTION_SPEC,
                              f"{where}.delay_regimes.{key}")
+    if "triage" in block:
+        errors += validate_triage(block["triage"], f"{where}.triage")
     return errors
 
 
@@ -564,6 +718,15 @@ def validate_progress_stream(lines, where: str = "progress") -> List[str]:
             errors += _check(rec["stages"],
                              {s: _NUM for s in DISPATCH_STAGES},
                              f"{rw}.stages")
+        anomalies = rec.get("anomalies")
+        if isinstance(anomalies, dict):
+            for name, count in anomalies.items():
+                if name not in TRIAGE_CLASSES:
+                    errors.append(f"{rw}.anomalies.{name}: unknown "
+                                  f"triage class")
+                if not isinstance(count, int) or isinstance(count, bool):
+                    errors.append(f"{rw}.anomalies.{name}: expected int, "
+                                  f"got {type(count).__name__}")
     if not saw_dispatch:
         errors.append(f"{where}: no dispatch heartbeat records")
     return errors

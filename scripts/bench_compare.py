@@ -109,9 +109,11 @@ def compare_run(current: Dict, baseline: Dict, where: str,
                           f"!= baseline {base_tel.get(key)!r}")
 
     # Fleet campaigns: every field of the campaign block (scenario-kind
-    # counts, spot-check results, nearest-rank distributions) is derived
-    # from the campaign seed, so it must match exactly like any other
-    # protocol count.
+    # counts, spot-check results, nearest-rank distributions, and the
+    # schema-v8 triage block — per-class anomaly counts, exemplar refs,
+    # extracted recorder rings) is derived from the campaign seed, so it
+    # must match exactly like any other protocol count. The triage block
+    # is required to stay wall-clock-free for exactly this reason.
     if "campaign" in current or "campaign" in baseline:
         cur_c = current.get("campaign") or {}
         base_c = baseline.get("campaign") or {}

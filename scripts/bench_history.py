@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Bench-history trend report: fold the harness's per-round capture
+records into one table.
+
+The capture harness drops ``BENCH_rNN.json`` / ``MULTICHIP_rNN.json``
+records at the repo root after each round — ``{n, cmd, rc, tail,
+parsed}`` where ``parsed`` is the JSON of the bench shim's last stdout
+line (the compact suite payload ``bench.py`` always flushes), and
+``{n_devices, rc, ok, skipped, tail}`` for the multi-chip dry run. This
+tool reads every record plus ``benchmarks/baseline.json`` and prints a
+per-round trend of the throughput figures that matter (per-suite
+ticks/sec, the fleet campaign's clusters/sec) against the committed
+baseline.
+
+Dead records are the whole point: a round whose ``tail`` is empty or
+whose ``parsed`` is null means the bench ran but its output was lost —
+historically a wall-budget kill with nothing flushed (``bench.py`` now
+emits the summary line even on partial completion, so new dead records
+indicate a capture bug, not a budget cut). Every such record is flagged
+loudly on stderr and ``--strict`` turns any dead/partial round into
+exit 1.
+
+Usage::
+
+    python scripts/bench_history.py            # repo-root records
+    python scripts/bench_history.py --dir PATH --json out.json --strict
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Suite entries whose ticks_per_sec trend is worth a column (matches
+#: bench.py's SUITE_ENTRIES; fleet reports clusters_per_sec instead).
+RATE_ENTRIES = ("steady", "churn", "contested", "partition", "delay")
+
+
+def _round_no(path: str, record: Dict) -> int:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    n = record.get("n")
+    return n if isinstance(n, int) else -1
+
+
+def _rate(entry: Optional[Dict], key: str) -> Optional[float]:
+    if not isinstance(entry, dict):
+        return None
+    value = entry.get(key)
+    return value if isinstance(value, (int, float)) else None
+
+
+def _fold_bench(path: str) -> Dict[str, object]:
+    """One BENCH_rNN.json -> a trend row (never raises: unreadable
+    records become dead rows, which is exactly what we report)."""
+    row: Dict[str, object] = {"path": os.path.basename(path),
+                              "round": -1, "rc": None, "dead": True,
+                              "partial": None, "rates": {},
+                              "clusters_per_sec": None, "config": None,
+                              "problems": []}
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as err:
+        row["problems"].append(f"unreadable record: {err}")
+        return row
+    row["round"] = _round_no(path, record)
+    row["rc"] = record.get("rc")
+    tail = record.get("tail")
+    parsed = record.get("parsed")
+    if parsed is None and isinstance(tail, str) and tail.strip():
+        # The harness may store the tail unparsed; recover it here.
+        try:
+            parsed = json.loads(tail.strip().splitlines()[-1])
+        except ValueError:
+            row["problems"].append("tail is not JSON")
+    if not isinstance(tail, str) or not tail.strip():
+        row["problems"].append("empty tail — bench output lost")
+    if not isinstance(parsed, dict):
+        row["problems"].append("no parsed payload")
+        return row
+    row["dead"] = False
+    row["config"] = {"n": parsed.get("n"), "ticks": parsed.get("ticks")}
+    partial = parsed.get("partial")
+    if isinstance(partial, dict):
+        row["partial"] = partial
+        row["problems"].append(
+            f"partial run: missing {partial.get('missing')} "
+            f"({partial.get('error')})")
+    row["rates"] = {name: _rate(parsed.get(name), "ticks_per_sec")
+                    for name in RATE_ENTRIES}
+    row["clusters_per_sec"] = _rate(parsed.get("fleet"),
+                                    "clusters_per_sec")
+    return row
+
+
+def _fold_multichip(path: str) -> Dict[str, object]:
+    row: Dict[str, object] = {"path": os.path.basename(path),
+                              "round": -1, "rc": None, "ok": None,
+                              "skipped": None, "problems": []}
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as err:
+        row["problems"].append(f"unreadable record: {err}")
+        return row
+    row["round"] = _round_no(path, record)
+    row.update(rc=record.get("rc"), ok=record.get("ok"),
+               skipped=record.get("skipped"))
+    if record.get("ok") is not True and not record.get("skipped"):
+        row["problems"].append("multichip round neither ok nor skipped")
+    return row
+
+
+def _baseline_row(path: str) -> Optional[Dict[str, object]]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        baseline = json.load(fh)
+    return {"path": os.path.relpath(path, _REPO), "round": None,
+            "rc": 0, "dead": False, "partial": None,
+            "config": {"n": baseline.get("n"),
+                       "ticks": baseline.get("ticks")},
+            "rates": {name: _rate(baseline.get(name), "ticks_per_sec")
+                      for name in RATE_ENTRIES},
+            "clusters_per_sec": _rate(baseline.get("fleet"),
+                                      "clusters_per_sec"),
+            "problems": []}
+
+
+def _fmt(value: Optional[float]) -> str:
+    if value is None:
+        return "--"
+    return f"{value:.0f}" if value >= 10 else f"{value:.2f}"
+
+
+def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
+    bench_rows = [_fold_bench(p) for p in
+                  sorted(glob.glob(os.path.join(directory,
+                                                "BENCH_r*.json")))]
+    multichip_rows = [_fold_multichip(p) for p in
+                      sorted(glob.glob(os.path.join(
+                          directory, "MULTICHIP_r*.json")))]
+    return {"record": "bench_history",
+            "directory": directory,
+            "baseline": _baseline_row(baseline_path),
+            "rounds": bench_rows,
+            "multichip": multichip_rows,
+            "dead_rounds": [r["path"] for r in bench_rows if r["dead"]],
+            "partial_rounds": [r["path"] for r in bench_rows
+                               if r["partial"]]}
+
+
+def render(report: Dict[str, object]) -> str:
+    lines = []
+    header = (["round", "rc"] + list(RATE_ENTRIES)
+              + ["fleet cl/s", "flags"])
+    rows: List[List[str]] = []
+    baseline = report["baseline"]
+    for row in ([baseline] if baseline else []) + list(report["rounds"]):
+        label = "baseline" if row["round"] is None else f"r{row['round']:02d}"
+        flags = ("DEAD" if row["dead"]
+                 else "PARTIAL" if row["partial"] else "ok")
+        rows.append([label, str(row["rc"])]
+                    + [_fmt(row["rates"].get(name))
+                       for name in RATE_ENTRIES]
+                    + [_fmt(row["clusters_per_sec"]), flags])
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              if rows else len(header[i]) for i in range(len(header))]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    for row in report["multichip"]:
+        state = ("ok" if row["ok"] else
+                 "skipped" if row["skipped"] else "FAILED")
+        lines.append(f"multichip r{row['round']:02d}: {state} "
+                     f"(rc={row['rc']})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--dir", default=_REPO,
+                        help="directory holding BENCH_r*/MULTICHIP_r* "
+                             "records (default: repo root)")
+    parser.add_argument("--baseline",
+                        default=os.path.join(_REPO, "benchmarks",
+                                             "baseline.json"),
+                        help="committed baseline for the reference row")
+    parser.add_argument("--json", default=None, metavar="FILE",
+                        help="also write the folded report as JSON")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 when any round is dead or partial")
+    args = parser.parse_args(argv)
+
+    report = build_report(args.dir, args.baseline)
+    if not report["rounds"] and not report["multichip"]:
+        print(f"bench_history: no BENCH_r*/MULTICHIP_r* records under "
+              f"{args.dir}", file=sys.stderr)
+        return 1
+    print(render(report))
+    for row in report["rounds"] + report["multichip"]:
+        for problem in row["problems"]:
+            print(f"bench_history: WARNING: {row['path']}: {problem}",
+                  file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+            fh.write("\n")
+    bad = report["dead_rounds"] + report["partial_rounds"]
+    if args.strict and bad:
+        print(f"bench_history: {len(bad)} dead/partial round(s): "
+              f"{', '.join(bad)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

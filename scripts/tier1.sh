@@ -232,6 +232,37 @@ sys.exit(0 if ok else 1)'; then
     fi
 fi
 
+# Triage + replay smoke: a recorder-on campaign must emit a schema-v8
+# triage block that flags at least one member with a full exemplar
+# (expected fold + flight-recorder ring), and `python -m
+# rapid_tpu.replay` must reconstruct that member from the payload alone
+# and prove bit-identity — the replay CLI itself exits 1 on any
+# expected-block or recorder-ring mismatch, so its rc is the verdict.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 8 --fleet-size 4 --n 24 --ticks 120 \
+            --flight-recorder 24 --out /tmp/_t1_triage.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_triage.json \
+        && ref=$(python -c '
+import json, sys
+triage = json.load(open("/tmp/_t1_triage.json"))["campaign"]["triage"]
+if triage["flagged_members"] < 1:
+    sys.exit(1)
+for block in triage["classes"].values():
+    for ex in block["exemplars"]:
+        if ex["expected"] is not None and ex["recorder"] is not None:
+            print("%d:%d" % (ex["dispatch"], ex["member_index"]))
+            sys.exit(0)
+sys.exit(1)') \
+        && timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.replay \
+            --payload /tmp/_t1_triage.json --member "$ref" >/dev/null; then
+        echo TRIAGE_SMOKE=ok
+    else
+        echo TRIAGE_SMOKE=failed
+        rc=1
+    fi
+fi
+
 # Kernel-profile smoke: the per-kernel cost observatory must lower every
 # sub-kernel and emit a schema-valid dominance report (small N, few
 # repeats — the full 1k/10k/100k sweep is run manually; see

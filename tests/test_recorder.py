@@ -1,0 +1,274 @@
+"""On-device flight recorder: bounded gauge ring + first-occurrence
+stamps threaded through both scan kernels, byte-identical jaxpr with the
+recorder off, and host-side extraction helpers."""
+import importlib
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from rapid_tpu import hashing
+from rapid_tpu.engine import recorder
+from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+from rapid_tpu.faults import AdversarySchedule, LinkWindow
+from rapid_tpu.settings import Settings
+
+step_module = importlib.import_module("rapid_tpu.engine.step")
+receiver_module = importlib.import_module("rapid_tpu.engine.receiver")
+fleet_module = importlib.import_module("rapid_tpu.engine.fleet")
+
+# Distinct seeds keep each test's Settings a fresh jit-cache row (same
+# discipline as test_invariants.py).
+OFF = Settings(seed=9101)
+ON = replace(OFF, flight_recorder_window=8)
+
+
+def synthetic_uids(n: int, seed: int = 0) -> np.ndarray:
+    """Same synthetic identity scheme as benchmarks/bench_engine.py."""
+    hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF ^ (seed & 0xFFFF))
+    return hashing.np_from_limbs(hi, lo)
+
+
+def boot(n: int, settings):
+    return init_state(synthetic_uids(n), id_fp_sum=0, settings=settings)
+
+
+def no_faults(n: int):
+    return crash_faults([I32_MAX] * n)
+
+
+def crash_burst(n: int, tick: int = 3, count: int = 4):
+    ticks = [I32_MAX] * n
+    for slot in range(count):
+        ticks[slot] = tick
+    return crash_faults(ticks)
+
+
+# ---------------------------------------------------------------------------
+# configuration / ring mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_settings_reject_negative_window():
+    with pytest.raises(ValueError):
+        Settings(flight_recorder_window=-1)
+
+
+def test_init_requires_positive_window():
+    with pytest.raises(ValueError):
+        recorder.init(OFF)
+    rec = recorder.init(ON)
+    assert rec.ring.shape == (8, recorder.N_GAUGES)
+    assert int(np.asarray(rec.count)) == 0
+    assert int(np.asarray(rec.first_decide)) == -1
+    assert np.all(np.asarray(rec.ring) == recorder.UNOBSERVED)
+
+
+def test_ring_rows_chronological_after_wraparound():
+    # Push synthetic rows past the window; extraction must return the
+    # last W in chronological order, not raw ring order.
+    rec = recorder.init(ON)
+    for tick in range(1, 12):
+        row = jnp.full((recorder.N_GAUGES,), tick, jnp.int32)
+        rec = recorder._push(rec, row, jnp.int32(tick),
+                             jnp.asarray(False), jnp.asarray(False),
+                             jnp.asarray(False), jnp.asarray(False))
+    rows = np.asarray(recorder.ring_rows(rec))
+    assert rows.shape == (8, recorder.N_GAUGES)
+    assert list(rows[:, 0]) == list(range(4, 12))
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when off: byte-identical jaxpr, recorder never entered
+# ---------------------------------------------------------------------------
+
+
+def test_shared_off_jaxpr_byte_identical_to_raw_scan():
+    n = 16
+    state, faults = boot(n, OFF), no_faults(n)
+
+    def raw(s, f):
+        def body(carry, _):
+            return step_module.step(carry, f, OFF)
+
+        return lax.scan(body, s, None, length=10)
+
+    off = str(jax.make_jaxpr(
+        lambda s, f: step_module._simulate.__wrapped__(s, f, 10, OFF))(
+            state, faults))
+    ref = str(jax.make_jaxpr(raw)(state, faults))
+    assert off == ref
+
+
+def test_receiver_off_jaxpr_byte_identical_to_raw_scan():
+    settings = replace(OFF, capacity=12, seed=9102)
+    schedule = AdversarySchedule(n=12, seed=3)
+    member = fleet_module.lower_receiver_schedule(schedule, settings)
+
+    def raw(rs, f):
+        def body(carry, _):
+            return receiver_module.receiver_step(carry, f, settings)
+
+        return lax.scan(body, rs, None, length=10)
+
+    off = str(jax.make_jaxpr(
+        lambda rs, f: receiver_module._simulate.__wrapped__(
+            rs, f, 10, settings))(member.state, member.faults))
+    ref = str(jax.make_jaxpr(raw)(member.state, member.faults))
+    assert off == ref
+
+
+def test_off_never_calls_record_step(monkeypatch):
+    calls = []
+    real = recorder.record_step
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    # step.py calls recorder.record_step by module attribute, so the spy
+    # sees every compile-time entry into the recorder.
+    monkeypatch.setattr(recorder, "record_step", spy)
+
+    n = 16
+    off = replace(OFF, seed=9103)
+    state, faults = boot(n, off), no_faults(n)
+    jax.make_jaxpr(
+        lambda s, f: step_module._simulate.__wrapped__(s, f, 3, off))(
+            state, faults)
+    assert calls == [], "recorder off must never enter recorder.py"
+
+    on = replace(off, flight_recorder_window=4)
+    jax.make_jaxpr(
+        lambda s, f: step_module._simulate.__wrapped__(s, f, 3, on))(
+            state, faults)
+    assert len(calls) == 1  # the scan body traces once
+
+
+def test_receiver_off_never_calls_record_receiver_step(monkeypatch):
+    calls = []
+    real = recorder.record_receiver_step
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(recorder, "record_receiver_step", spy)
+
+    settings = replace(OFF, capacity=12, seed=9104)
+    schedule = AdversarySchedule(n=12, seed=4)
+    member = fleet_module.lower_receiver_schedule(schedule, settings)
+    jax.make_jaxpr(
+        lambda rs, f: receiver_module._simulate.__wrapped__(
+            rs, f, 3, settings))(member.state, member.faults)
+    assert calls == []
+
+    on = replace(settings, flight_recorder_window=4)
+    jax.make_jaxpr(
+        lambda rs, f: receiver_module._simulate.__wrapped__(
+            rs, f, 3, on))(member.state, member.faults)
+    assert len(calls) == 1  # the scan body traces once
+
+
+# ---------------------------------------------------------------------------
+# recorder on: transparent to the protocol, rings carry real gauges
+# ---------------------------------------------------------------------------
+
+
+def test_shared_recorder_transparent_and_ring_matches_logs():
+    # Same shape as test_invariants' clean steady run: the crash burst
+    # at tick 5 saturates the FD and actually decides inside 130 ticks,
+    # so the first_announce/first_decide stamps carry real ticks.
+    n = 64
+    off = replace(OFF, seed=9105)
+    on = replace(off, flight_recorder_window=8)
+    state, faults = boot(n, off), crash_burst(n, tick=5, count=8)
+
+    _, logs_off = step_module.simulate(state, faults, 130, off)
+    final, logs_on, rec = step_module.simulate(state, faults, 130, on)
+    np.testing.assert_array_equal(np.asarray(logs_off.decide_now),
+                                  np.asarray(logs_on.decide_now))
+    np.testing.assert_array_equal(np.asarray(logs_off.epoch),
+                                  np.asarray(logs_on.epoch))
+
+    assert int(np.asarray(rec.count)) == 130
+    rows = np.asarray(recorder.ring_rows(rec))
+    assert rows.shape == (8, recorder.N_GAUGES)
+    gauge = {name: i for i, name in enumerate(recorder.GAUGE_NAMES)}
+    # The ring's last-W ticks mirror the full StepLog gauges exactly.
+    np.testing.assert_array_equal(rows[:, gauge["tick"]],
+                                  np.asarray(logs_on.tick)[-8:])
+    np.testing.assert_array_equal(rows[:, gauge["epoch"]],
+                                  np.asarray(logs_on.epoch)[-8:])
+    # Receiver-only gauges stay unobserved in the shared kernel.
+    assert np.all(rows[:, gauge["sent"]] == recorder.UNOBSERVED)
+    assert np.all(rows[:, gauge["flags"]] == recorder.UNOBSERVED)
+
+    stamps = recorder.stamps(rec)
+    decides = np.asarray(logs_on.decide_now)
+    first_decide = int(np.asarray(logs_on.tick)[decides.argmax()])
+    assert decides.any()
+    assert stamps["first_decide"] == first_decide
+    assert 0 < stamps["first_announce"] <= stamps["first_decide"]
+    assert stamps["first_violation"] == -1
+
+
+def test_receiver_recorder_transparent_and_flags_gauge():
+    n = 12
+    settings = replace(OFF, capacity=n, seed=9106)
+    on = replace(settings, flight_recorder_window=6)
+    schedule = AdversarySchedule(
+        n=n, seed=9, crashes=((0, 4),),
+        windows=(LinkWindow(src_slots=frozenset(range(0, 4)),
+                            dst_slots=frozenset(range(4, 12)),
+                            start_tick=2, end_tick=9),))
+    member = fleet_module.lower_receiver_schedule(schedule, settings)
+
+    _, logs_off = receiver_module.receiver_simulate(
+        member.state, member.faults, 25, settings)
+    member_on = fleet_module.lower_receiver_schedule(schedule, on)
+    _, logs_on, rec = receiver_module.receiver_simulate(
+        member_on.state, member_on.faults, 25, on)
+    np.testing.assert_array_equal(np.asarray(logs_off.sent),
+                                  np.asarray(logs_on.sent))
+    np.testing.assert_array_equal(np.asarray(logs_off.decide),
+                                  np.asarray(logs_on.decide))
+
+    rows = np.asarray(recorder.ring_rows(rec))
+    gauge = {name: i for i, name in enumerate(recorder.GAUGE_NAMES)}
+    assert rows.shape == (6, recorder.N_GAUGES)
+    np.testing.assert_array_equal(rows[:, gauge["sent"]],
+                                  np.asarray(logs_on.sent)[-6:])
+    # Shared-only gauges stay unobserved in the receiver kernel.
+    assert np.all(rows[:, gauge["epoch"]] == recorder.UNOBSERVED)
+    assert np.all(rows[:, gauge["vote_tally"]] == recorder.UNOBSERVED)
+
+
+def test_fleet_recorder_slices_per_member():
+    n = 16
+    on = replace(OFF, flight_recorder_window=5, seed=9107)
+    members = [
+        fleet_module.lower_schedule(
+            AdversarySchedule(n=n, seed=s, crashes=((0, 2 + s),)), on)
+        for s in range(3)
+    ]
+    fleet = fleet_module.stack_members(members)
+    finals, logs, recs = fleet_module.fleet_simulate(fleet, 12, on)
+    assert recs.ring.shape == (3, 5, recorder.N_GAUGES)
+    for i in range(3):
+        one = recorder.member_recorder(recs, i)
+        payload = recorder.recorder_payload(one)
+        assert payload["window"] == 5
+        assert payload["ticks_recorded"] == 12
+        assert payload["gauges"] == list(recorder.GAUGE_NAMES)
+        assert len(payload["rows"]) == 5
+        # Per-member slice equals a solo run of the same member.
+        solo = fleet_module.stack_members([members[i]])
+        _, _, solo_rec = fleet_module.fleet_simulate(solo, 12, on)
+        np.testing.assert_array_equal(
+            np.asarray(one.ring),
+            np.asarray(recorder.member_recorder(solo_rec, 0).ring))

@@ -1,0 +1,160 @@
+"""Deterministic single-member replay: the triage exemplars of a
+recorder-on campaign must replay bit-identically — expected block and
+flight-recorder ring both — through ``rapid_tpu.replay``, in each
+dispatch mode, and the verifier must actually fail on tampered data."""
+import copy
+import json
+
+import pytest
+
+from rapid_tpu import replay as replay_mod
+from rapid_tpu.campaign import CampaignConfig, run_campaign
+
+#: Cheapest recorder-on campaign that flags members in BOTH dispatch
+#: modes: seed 0 of the default mix samples churn members (shared path,
+#: never decide inside 120 ticks) and slow_asym members (per-receiver
+#: path, same anomaly), so triage carries exemplars for each.
+CFG = CampaignConfig(clusters=8, n=24, ticks=120, fleet_size=4,
+                     spot_checks=0, flight_recorder=24)
+
+
+@pytest.fixture(scope="module")
+def payload():
+    return run_campaign(CFG)
+
+
+def _exemplar_refs(payload, mode):
+    triage = payload["campaign"]["triage"]
+    return [ex for block in triage["classes"].values()
+            for ex in block["exemplars"]
+            if ex["mode"] == mode and ex["expected"] is not None]
+
+
+def _assert_verified(record, exemplar):
+    assert record["match"] is True
+    assert record["mismatches"] is None
+    assert record["recorder_match"] is True
+    assert record["triage_class"] is not None
+    # Identity fields come from the replayed sampling chain, not the
+    # exemplar — equality proves the chain reconstructed the member.
+    assert record["member"] == exemplar["member"]
+    assert record["kind"] == exemplar["kind"]
+    assert record["seed"] == exemplar["seed"]
+    assert record["replayed"] == exemplar["expected"]
+    assert record["recorder"] == exemplar["recorder"]
+    assert record["recorder"]["window"] == CFG.flight_recorder
+
+
+def test_campaign_flags_members_in_both_modes(payload):
+    # Guard for the fixture config itself: the replay tests below need
+    # at least one verified exemplar on each engine path.
+    assert _exemplar_refs(payload, "shared")
+    assert _exemplar_refs(payload, "per_receiver")
+
+
+def test_shared_exemplar_replays_bit_identical(payload):
+    ex = _exemplar_refs(payload, "shared")[0]
+    record = replay_mod.replay_member(payload, ex["dispatch"],
+                                      ex["member_index"])
+    assert record["mode"] == "shared"
+    _assert_verified(record, ex)
+
+
+def test_receiver_exemplar_replays_bit_identical(payload):
+    ex = _exemplar_refs(payload, "per_receiver")[0]
+    record = replay_mod.replay_member(payload, ex["dispatch"],
+                                      ex["member_index"])
+    assert record["mode"] == "per_receiver"
+    _assert_verified(record, ex)
+
+
+def test_unflagged_member_replays_without_verdict(payload):
+    flagged = {(ex["dispatch"], ex["member_index"])
+               for mode in ("shared", "per_receiver")
+               for ex in _exemplar_refs(payload, mode)}
+    shared_d = _exemplar_refs(payload, "shared")[0]["dispatch"]
+    target = next((shared_d, j) for j in range(CFG.fleet_size)
+                  if (shared_d, j) not in flagged)
+    record = replay_mod.replay_member(payload, *target)
+    assert record["match"] is None
+    assert record["triage_class"] is None
+    # The member still gets the full fold and its recorder ring.
+    assert set(record["replayed"]) == set(
+        _exemplar_refs(payload, "shared")[0]["expected"])
+    assert record["recorder"]["ticks_recorded"] == CFG.ticks
+
+
+def test_tampered_expected_block_fails_verification(payload):
+    tampered = copy.deepcopy(payload)
+    ex = _exemplar_refs(tampered, "shared")[0]
+    key = next(k for k, v in ex["expected"].items()
+               if isinstance(v, int))
+    ex["expected"][key] += 1
+    record = replay_mod.replay_member(tampered, ex["dispatch"],
+                                      ex["member_index"])
+    assert record["match"] is False
+    assert key in record["mismatches"]
+
+
+def test_tampered_recorder_ring_fails_verification(payload):
+    tampered = copy.deepcopy(payload)
+    ex = _exemplar_refs(tampered, "shared")[0]
+    ex["recorder"]["rows"][-1][0] += 1
+    record = replay_mod.replay_member(tampered, ex["dispatch"],
+                                      ex["member_index"])
+    assert record["match"] is True  # the fold itself is untouched
+    assert record["recorder_match"] is False
+
+
+def test_out_of_range_refs_rejected(payload):
+    with pytest.raises(ValueError, match="out of range"):
+        replay_mod.replay_member(payload, 99, 0)
+    with pytest.raises(ValueError, match="padded slots|out of range"):
+        replay_mod.replay_member(payload, 0, CFG.fleet_size)
+
+
+def test_pre_v8_payload_rejected(payload):
+    old = copy.deepcopy(payload)
+    del old["campaign"]["weights"]
+    with pytest.raises(ValueError, match="schema >= 8"):
+        replay_mod.replay_member(old, 0, 0)
+    with pytest.raises(ValueError, match="campaign"):
+        replay_mod.replay_member({"bench": "x"}, 0, 0)
+
+
+def test_cli_roundtrip_writes_artifacts(payload, tmp_path, capsys):
+    ex = _exemplar_refs(payload, "shared")[0]
+    ppath = tmp_path / "campaign.json"
+    ppath.write_text(json.dumps(payload))
+    metrics = tmp_path / "member.jsonl"
+    trace = tmp_path / "member_trace.json"
+    out = tmp_path / "replay.json"
+    rc = replay_mod.main([
+        "--payload", str(ppath),
+        "--member", f"{ex['dispatch']}:{ex['member_index']}",
+        "--metrics", str(metrics), "--trace", str(trace),
+        "--out", str(out)])
+    assert rc == 0
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["match"] is True and record["recorder_match"] is True
+    assert record == json.loads(out.read_text())
+    rows = [json.loads(line) for line in
+            metrics.read_text().splitlines()]
+    assert len(rows) == CFG.ticks
+    assert json.loads(trace.read_text())["traceEvents"]
+
+
+def test_cli_exit_one_on_mismatch(payload, tmp_path, capsys):
+    tampered = copy.deepcopy(payload)
+    ex = _exemplar_refs(tampered, "shared")[0]
+    key = next(k for k, v in ex["expected"].items()
+               if isinstance(v, int))
+    ex["expected"][key] += 1
+    ppath = tmp_path / "tampered.json"
+    ppath.write_text(json.dumps(tampered))
+    rc = replay_mod.main([
+        "--payload", str(ppath),
+        "--member", f"{ex['dispatch']}:{ex['member_index']}"])
+    assert rc == 1
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["match"] is False

@@ -922,8 +922,12 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             else:
                 rx_dispatches += 1
                 for j in range(len(chunk)):
-                    mrs = jax.tree_util.tree_map(lambda x, j=j: x[j],
-                                                 finals)
+                    # Packed fleets return packed finals (the memory
+                    # diet covers dispatch outputs); the view shim
+                    # unpacks just the fields the fold reads.
+                    mrs = receiver_mod.receiver_final_view(
+                        jax.tree_util.tree_map(lambda x, j=j: x[j],
+                                               finals))
                     mlog = jax.tree_util.tree_map(lambda x, j=j: x[j],
                                                   logs)
                     # A nonzero envelope flag voids the device-exact
@@ -1108,6 +1112,13 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         k = scenarios[i].kind
         rx_kinds[k] = rx_kinds.get(k, 0) + 1
     rx_capacity = max(rx_settings.capacity, cfg.n)
+    rx_member_bytes = receiver_mod.receiver_state_bytes(
+        rx_capacity, base.K, ring_depth=base.delivery_ring_depth)
+    if base.rx_kernel != "xla":
+        from rapid_tpu.engine import rx_packed
+
+        rx_member_bytes = rx_packed.bundle_state_bytes(
+            rx_capacity, rx_settings)
     per_receiver = {
         "enabled": cfg.per_receiver,
         "members": len(rx_idx),
@@ -1116,7 +1127,9 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         "capacity": rx_capacity,
         "capacity_cap": base.receiver_capacity_cap,
         "ring_depth": base.delivery_ring_depth,
-        "member_state_bytes": receiver_mod.receiver_state_bytes(
+        "rx_kernel": base.rx_kernel,
+        "member_state_bytes": rx_member_bytes,
+        "member_state_bytes_unpacked": receiver_mod.receiver_state_bytes(
             rx_capacity, base.K, ring_depth=base.delivery_ring_depth),
         "kinds": dict(sorted(rx_kinds.items())),
     }
@@ -1285,8 +1298,19 @@ def main(argv=None) -> int:
                              "scan and embed the rings of triage-flagged "
                              "exemplars in the payload (0 = compiled "
                              "out, byte-identical member programs)")
+    parser.add_argument("--rx-kernel", type=str, default="xla",
+                        choices=("xla", "packed", "pallas"),
+                        help="per-receiver state layout/kernel: 'xla' "
+                             "(dense, default), 'packed' (bit-plane carry "
+                             "through the scan), 'pallas' (packed carry + "
+                             "pallas deliver/aggregate kernel; interpreted "
+                             "off-TPU). Spot-check referees inherit the "
+                             "same setting, so exactness gates cover it")
     args = parser.parse_args(argv)
 
+    settings = None
+    if args.rx_kernel != "xla":
+        settings = Settings(rx_kernel=args.rx_kernel)
     cfg = CampaignConfig(clusters=args.clusters, n=args.n, ticks=args.ticks,
                          seed=args.seed, fleet_size=args.fleet_size,
                          headroom=args.headroom, weights=args.weights,
@@ -1297,7 +1321,8 @@ def main(argv=None) -> int:
                          pipeline=args.pipeline,
                          fleet_shard=args.fleet_shard,
                          compile_cache=args.compile_cache,
-                         flight_recorder=args.flight_recorder)
+                         flight_recorder=args.flight_recorder,
+                         settings=settings)
     payload = run_campaign(cfg, trace_path=args.trace,
                            progress_path=args.progress)
     if args.out:

@@ -152,11 +152,17 @@ _BOOT_CACHE: Dict[Tuple, EngineState] = {}
 #: replaces just its delay table.
 _RX_BOOT_CACHE: Dict[Tuple, object] = {}
 
+#: Packed twins of the rx boot templates (``rx_kernel != "xla"``), same
+#: key: the packed carry is delay-table-independent by construction
+#: (the table rides ``PackedReceiverBundle``, outside the carry).
+_RX_PACKED_CACHE: Dict[Tuple, object] = {}
+
 
 def clear_boot_caches() -> None:
     """Drop the memoized boot states (tests; long multi-config runs)."""
     _BOOT_CACHE.clear()
     _RX_BOOT_CACHE.clear()
+    _RX_PACKED_CACHE.clear()
     _default_identities_cached.cache_clear()
 
 
@@ -508,33 +514,63 @@ class ReceiverBudgetError(ValueError):
     an opaque device OOM mid-campaign."""
 
     def __init__(self, capacity: int, fleet_size: int, cap: int,
-                 member_bytes: int, total_bytes: int) -> None:
+                 member_bytes: int, total_bytes: int, *,
+                 packed_bytes: Optional[int] = None,
+                 unpacked_bytes: Optional[int] = None) -> None:
         self.capacity = capacity
         self.fleet_size = fleet_size
         self.cap = cap
         self.member_bytes = member_bytes
         self.total_bytes = total_bytes
+        self.packed_bytes = packed_bytes
+        self.unpacked_bytes = unpacked_bytes
+        diet = ""
+        if packed_bytes is not None and unpacked_bytes:
+            diet = (f"; packed layout {packed_bytes / 2**20:.1f} MiB vs "
+                    f"{unpacked_bytes / 2**20:.1f} MiB dense "
+                    f"({unpacked_bytes / packed_bytes:.1f}x headroom via "
+                    f"Settings.rx_kernel)")
         super().__init__(
             f"per-receiver fleet over budget: capacity {capacity} > "
             f"receiver_capacity_cap {cap} "
             f"({member_bytes / 2**20:.1f} MiB/member, "
             f"{total_bytes / 2**20:.1f} MiB for fleet of {fleet_size}; "
-            f"raise Settings.receiver_capacity_cap to override)")
+            f"raise Settings.receiver_capacity_cap to override{diet})")
 
 
 def check_receiver_budget(capacity: int, fleet_size: int,
                           settings: Settings) -> int:
     """Size a per-receiver fleet; returns per-member bytes or raises
     :class:`ReceiverBudgetError` when ``capacity`` exceeds
-    ``settings.receiver_capacity_cap``."""
+    ``settings.receiver_capacity_cap``.
+
+    The byte figure is derived from the *actual* state pytree the fleet
+    program is lowered over — ``jax.eval_shape`` over the boot skeleton
+    (and, for ``rx_kernel != "xla"``, over ``rx_packed``'s real pack
+    function) — so it cannot drift when the layout changes; the dense
+    figure is additionally asserted against the historical shape table
+    (``receiver_state_bytes``). ``profile.receiver_memory_block`` pins
+    this figure against XLA's measured argument bytes within 1%."""
+    from rapid_tpu.engine import rx_packed
     from rapid_tpu.engine.receiver import receiver_state_bytes
 
-    member_bytes = receiver_state_bytes(
+    dense_bytes = rx_packed.dense_state_bytes(capacity, settings)
+    assert dense_bytes == receiver_state_bytes(
         capacity, settings.K, ring_depth=settings.delivery_ring_depth)
+    packed_bytes = None
+    member_bytes = dense_bytes
+    if settings.rx_kernel != "xla":
+        packed_bytes = rx_packed.bundle_state_bytes(capacity, settings)
+        member_bytes = packed_bytes
     if capacity > settings.receiver_capacity_cap:
         raise ReceiverBudgetError(capacity, fleet_size,
                                   settings.receiver_capacity_cap,
-                                  member_bytes, member_bytes * fleet_size)
+                                  member_bytes, member_bytes * fleet_size,
+                                  packed_bytes=(
+                                      packed_bytes if packed_bytes is not None
+                                      else rx_packed.bundle_state_bytes(
+                                          capacity, settings)),
+                                  unpacked_bytes=dense_bytes)
     return member_bytes
 
 
@@ -587,10 +623,28 @@ def lower_receiver_schedule(schedule: AdversarySchedule,
             _RX_BOOT_CACHE[key] = template
         import jax.numpy as jnp
 
-        state = template._replace(delay_table=jnp.asarray(
-            build_delay_table(schedule.seed, c, N_DRAWS, eff)))
+        if eff.rx_kernel != "xla":
+            # The packed carry is delay-table-independent (the table
+            # rides the bundle, not the carry), so members sharing a
+            # boot template share one packed template too.
+            from rapid_tpu.engine import rx_packed
+
+            packed = _RX_PACKED_CACHE.get(key)
+            if packed is None:
+                packed = rx_packed.pack_receiver_state(template, eff)
+                _RX_PACKED_CACHE[key] = packed
+            state = rx_packed.PackedReceiverBundle(
+                packed=packed, delay_table=jnp.asarray(
+                    build_delay_table(schedule.seed, c, N_DRAWS, eff)))
+        else:
+            state = template._replace(delay_table=jnp.asarray(
+                build_delay_table(schedule.seed, c, N_DRAWS, eff)))
     else:
         state = init_receiver_state(uids, id_fp_sum, eff, seed=schedule.seed)
+        if eff.rx_kernel != "xla":
+            from rapid_tpu.engine import rx_packed
+
+            state = rx_packed.bundle_from_dense(state, eff)
     crash = np.full(c, np.iinfo(np.int32).max, np.int64)
     crash[:n] = schedule.crash_tick_array()
     faults = link_faults(crash.tolist(), schedule.windows, c,
@@ -618,9 +672,17 @@ def stack_receiver_members(members: Sequence[ReceiverMember], *,
 
     if not members:
         raise ValueError("empty fleet")
-    c0 = int(members[0].state.member.shape[0])
+
+    def _capacity(state) -> int:
+        # Packed bundles keep the slot axis first on every plane, so
+        # ``packed.member`` is [C, ceil(C/8)] — shape[0] is C either way.
+        packed = getattr(state, "packed", None)
+        inner = packed if packed is not None else state
+        return int(inner.member.shape[0])
+
+    c0 = _capacity(members[0].state)
     for m in members:
-        if int(m.state.member.shape[0]) != c0:
+        if _capacity(m.state) != c0:
             raise ValueError("fleet members must share one capacity")
     w = _resolve_max(n_windows,
                      max(m.faults.n_windows for m in members), "n_windows")

@@ -81,6 +81,32 @@ def link_blocked_matrix(xp, faults: EngineFaults, tick):
     return blocked
 
 
+def link_blocked_packed(xp, faults: EngineFaults, tick):
+    """uint8 [C, ceil(C/8)]: ``link_blocked_matrix`` as little-endian
+    bit-planes, built per window from the [C] slot masks — the dense
+    [C, C] plane is never materialized. Row ``s`` packs the dst axis, so
+    bit ``d`` of byte ``b`` in row ``s`` is ``blocked[s, 8*b + d]``;
+    trailing pad bits (when C % 8 != 0) are always zero, matching
+    ``xp.packbits``'s zero padding. Consumed by the pallas deliver
+    kernel (``engine.rx_pallas``) next to the packed message planes.
+    """
+    c = faults.crash_tick.shape[0]
+    blocked = xp.zeros((c, -(-c // 8)), xp.uint8)
+    if faults.n_windows == 0:
+        return blocked
+    active = link_window_active(xp, faults, tick)
+    zero = xp.uint8(0)
+    for w in range(faults.n_windows):
+        src_w, dst_w = faults.link_src[w], faults.link_dst[w]
+        pdst = xp.packbits(dst_w, bitorder="little")
+        psrc = xp.packbits(src_w, bitorder="little")
+        hit = xp.where(src_w[:, None], pdst[None, :], zero)
+        hit |= xp.where(faults.link_two_way[w] & dst_w[:, None],
+                        psrc[None, :], zero)
+        blocked |= xp.where(active[w], hit, zero)
+    return blocked
+
+
 def delay_matrix(xp, faults: EngineFaults, tick):
     """i32 [C, C]: extra delivery delay of a message sent src->dst at
     ``tick`` (send-time evaluation — latency is a property of the wire a

@@ -102,6 +102,8 @@ FLAG_MULTI_2B_ROUNDS = 8      # 2b traffic across distinct rounds
 FLAG_REGISTRY_MISS = 16       # vote/2a fingerprint not in announce registry
 FLAG_RING_COLLISION = 32      # same-kind same-sender same-arrival-tick pair
 FLAG_CROSS_PHASE_REORDER = 64  # older send arrived behind a fresher group
+FLAG_EPOCH_DELTA_SAT = 128    # packed epoch delta clamped (widen to 16-bit)
+FLAG_PACK_NARROW_SAT = 256    # packed narrow int leaf clamped (rx_packed)
 
 _FLAG_NAMES = {
     FLAG_DECIDE_NOT_IN_VIEW: "decide-host-not-in-view",
@@ -111,6 +113,8 @@ _FLAG_NAMES = {
     FLAG_REGISTRY_MISS: "proposal-registry-miss",
     FLAG_RING_COLLISION: "delivery-ring-collision",
     FLAG_CROSS_PHASE_REORDER: "cross-phase-send-order-inversion",
+    FLAG_EPOCH_DELTA_SAT: "epoch-delta-saturated",
+    FLAG_PACK_NARROW_SAT: "packed-narrow-overflow",
 }
 
 
@@ -137,11 +141,18 @@ def _cfg_eq(a_hi, a_lo, b_hi, b_lo):
     return (a_hi == b_hi) & (a_lo == b_lo)
 
 
-def _account(xp, msgs, crashed, emat):
+def _account(xp, msgs, crashed, emat, pallas=False):
     """Delivery mask + (delivered, dropped, link_dropped) counts for one
     message set ``msgs[src, dst]``, with the oracle's drop precedence:
     crashed src first, then crashed dst / link block (``link_dropped``
-    only counts blocks whose endpoints are both alive)."""
+    only counts blocks whose endpoints are both alive). With ``pallas``
+    (static, from ``Settings.rx_kernel``) the loop runs as the packed
+    bit-plane kernel in ``engine.rx_pallas`` — ``emat`` is then the
+    packed ``[C, ceil(C/8)]`` blocked plane, and the counts/mask are
+    bit-identical to this dense program."""
+    if pallas:
+        from rapid_tpu.engine import rx_pallas
+        return rx_pallas.account(msgs, crashed, emat)
     src_ok = ~crashed[:, None]
     dst_ok = ~crashed[None, :]
     deliv = msgs & src_ok & dst_ok & ~emat
@@ -273,7 +284,14 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     ridx = xp.arange(c, dtype=xp.int32)
     jidx = ridx
     crashed = monitor.crashed_at(faults, t)
-    emat = monitor.link_blocked_matrix(xp, faults, t)
+    # Static kernel select: the pallas path never materializes the dense
+    # [C, C] reachability plane — deliveries consume the packed bit-plane
+    # and FD probes evaluate their edges lazily (group 10).
+    pallas_rx = settings.rx_kernel == "pallas"
+    if pallas_rx:
+        emat = monitor.link_blocked_packed(xp, faults, t)
+    else:
+        emat = monitor.link_blocked_matrix(xp, faults, t)
     D = settings.delivery_ring_depth
     am = t % D                  # ring slot arriving this tick
     i32 = lambda x: xp.int32(x)
@@ -293,7 +311,8 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
 
     def deliver(msgs, phase=None):
         nonlocal delivered, dropped, link_dropped
-        dv, dn, dr, ld = _account(xp, msgs, crashed, emat)
+        dv, dn, dr, ld = _account(xp, msgs, crashed, emat,
+                                  pallas=pallas_rx)
         delivered += dn
         dropped += dr
         link_dropped += ld
@@ -446,13 +465,34 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     maxmask = prefix & (v.pb_vrnd_r == mr[:, None]) & (v.pb_vrnd_i == mi[:, None])
     collected = maxmask & v.pb_set
     ncoll = collected.sum(axis=1).astype(xp.int32)
-    eqf = ((v.pb_fp_hi[:, :, None] == v.pb_fp_hi[:, None, :])
-           & (v.pb_fp_lo[:, :, None] == v.pb_fp_lo[:, None, :]))
-    pair_uneq = (collected[:, :, None] & collected[:, None, :]
-                 & ~eqf).any(axis=(1, 2))
-    single = (ncoll >= 1) & ~pair_uneq
-    earlier = v.pb_seq[:, None, :] < v.pb_seq[:, :, None]
-    occ = (collected[:, None, :] & eqf & earlier).sum(axis=2).astype(xp.int32)
+    if settings.rx_kernel != "xla":
+        # Same pairwise-fingerprint math, evaluated one receiver row at
+        # a time (lax.map) so no [C, C, C] temp is ever materialized —
+        # bool/int ops only, so the row-chunked reduction is bit-exact.
+        # XLA fuses the dense form into a cubic int32 buffer (283 GiB
+        # at C=4096), which is what walls dense campaigns at ~1k slots.
+        def _pb_occ_row(args):
+            fp_hi, fp_lo, coll, seq = args
+            eq = ((fp_hi[:, None] == fp_hi[None, :])
+                  & (fp_lo[:, None] == fp_lo[None, :]))
+            uneq = (coll[:, None] & coll[None, :] & ~eq).any()
+            occ_r = (coll[None, :] & eq
+                     & (seq[None, :] < seq[:, None])).sum(
+                         axis=1).astype(xp.int32)
+            return uneq, occ_r
+
+        pair_uneq, occ = lax.map(
+            _pb_occ_row, (v.pb_fp_hi, v.pb_fp_lo, collected, v.pb_seq))
+        single = (ncoll >= 1) & ~pair_uneq
+    else:
+        eqf = ((v.pb_fp_hi[:, :, None] == v.pb_fp_hi[:, None, :])
+               & (v.pb_fp_lo[:, :, None] == v.pb_fp_lo[:, None, :]))
+        pair_uneq = (collected[:, :, None] & collected[:, None, :]
+                     & ~eqf).any(axis=(1, 2))
+        single = (ncoll >= 1) & ~pair_uneq
+        earlier = v.pb_seq[:, None, :] < v.pb_seq[:, :, None]
+        occ = (collected[:, None, :] & eqf & earlier).sum(
+            axis=2).astype(xp.int32)
     cand = collected & pair_uneq[:, None] & (occ == (v.px_n // 4)[:, None])
     d_single, _ = _pick_min_seq(xp, collected, v.pb_seq)
     d_cand, has_cand = _pick_min_seq(xp, cand, v.pb_seq)
@@ -496,11 +536,30 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     perm_v = xp.argsort(xp.where(wv_ring.any(axis=1), rs.wv_seq[am], I32_MAX))
     proc_s = process[:, perm_v]
     # Baseline: stored votes equal to each arriving fingerprint.
-    fp_eq_stored = ((v.vt_fp_hi[:, :, None] == wv_fp_hi_r[perm_v][None, None, :])
-                    & (v.vt_fp_lo[:, :, None]
-                       == wv_fp_lo_r[perm_v][None, None, :]))
-    baseline = (v.vt_seen[:, :, None] & fp_eq_stored).sum(axis=1).astype(
-        xp.int32)
+    if settings.rx_kernel != "xla":
+        # Row-chunked (lax.map) form of the stored-vote fingerprint
+        # match: the dense einsum-shaped broadcast below builds a
+        # [C, C, C] bool temp that XLA keeps live as int32 — the other
+        # half of the cubic memory wall. Equality + masked sum per row
+        # is bit-exact regardless of chunking.
+        wv_hi_p = wv_fp_hi_r[perm_v]
+        wv_lo_p = wv_fp_lo_r[perm_v]
+
+        def _vt_baseline_row(args):
+            th, tl, seen = args
+            eq = ((th[:, None] == wv_hi_p[None, :])
+                  & (tl[:, None] == wv_lo_p[None, :]))
+            return (seen[:, None] & eq).sum(axis=0).astype(xp.int32)
+
+        baseline = lax.map(
+            _vt_baseline_row, (v.vt_fp_hi, v.vt_fp_lo, v.vt_seen))
+    else:
+        fp_eq_stored = ((v.vt_fp_hi[:, :, None]
+                         == wv_fp_hi_r[perm_v][None, None, :])
+                        & (v.vt_fp_lo[:, :, None]
+                           == wv_fp_lo_r[perm_v][None, None, :]))
+        baseline = (v.vt_seen[:, :, None] & fp_eq_stored).sum(axis=1).astype(
+            xp.int32)
     prior_tot = v.vt_seen.sum(axis=1).astype(xp.int32)
     # Arrival-prefix counts of equal fingerprints, in announce order.
     fp_eq_wire = ((wv_fp_hi_r[perm_v][:, None] == wv_fp_hi_r[perm_v][None, :])
@@ -655,7 +714,17 @@ def receiver_step(rs: ReceiverState, faults: EngineFaults,
     at_thr = v.fc >= settings.fd_failure_threshold
     probing = v.own_fd_active & ~at_thr & is_fd[:, None]
     subj = v.own_subj
-    probe_fail = (crashed[subj] | crashed[:, None] | emat[ridx[:, None], subj])
+    if pallas_rx:
+        # Lazy per-edge reachability (monitor.link_blocked): W masked
+        # gathers over the [C, K] probe edges, never a [C, C] plane.
+        probe_fail = (crashed[subj] | crashed[:, None]
+                      | monitor.link_blocked(
+                          xp, faults,
+                          xp.broadcast_to(ridx[:, None], subj.shape),
+                          subj, t))
+    else:
+        probe_fail = (crashed[subj] | crashed[:, None]
+                      | emat[ridx[:, None], subj])
     probes_sent = probing.sum().astype(xp.int32)
     probes_failed = (probing & probe_fail).sum().astype(xp.int32)
     v.fc = xp.where(probing & probe_fail, v.fc + 1, v.fc)
@@ -938,8 +1007,26 @@ def receiver_simulate(rs: ReceiverState, faults: EngineFaults,
                       n_ticks: int, settings: Settings):
     """Run the jitted per-receiver scan; returns (final_state, logs) —
     or (final_state, logs, recorder) when
-    ``settings.flight_recorder_window > 0``."""
+    ``settings.flight_recorder_window > 0``. Under
+    ``settings.rx_kernel != "xla"`` the scan carries the packed layout
+    (``engine.rx_packed``) and unpacks the final state in-jit — same
+    return contract, bit-identical results."""
+    if settings.rx_kernel != "xla":
+        from rapid_tpu.engine import rx_packed
+        return rx_packed.simulate(rs, faults, n_ticks, settings)
     return _simulate(rs, faults, n_ticks, settings)
+
+
+def receiver_final_view(final):
+    """Dense view of the final-state fields host extraction reads
+    (member, stopped, cfg limbs, flags): the identity on dense finals,
+    a selective unpack on packed fleet finals (``rx_kernel != "xla"``
+    dispatches return ``rx_packed.PackedReceiverState`` finals to keep
+    the output transfer on the diet)."""
+    if isinstance(final, ReceiverState):
+        return final
+    from rapid_tpu.engine import rx_packed
+    return rx_packed.final_view(final)
 
 
 def _fleet_body(rs, faults, n_ticks: int, settings: Settings,
@@ -947,7 +1034,14 @@ def _fleet_body(rs, faults, n_ticks: int, settings: Settings,
     # ``fleet_mesh`` (static) partitions the vmapped member axis as
     # P("fleet") — each device owns whole members, no collectives. The
     # default None path traces a byte-identical jaxpr (no constraint
-    # eqns), mirroring step.fleet_body's contract.
+    # eqns), mirroring step.fleet_body's contract. Packed-layout fleets
+    # (``rx_kernel != "xla"`` — the stacked state is then a
+    # ``rx_packed.PackedReceiverBundle``) take the packed twin, which
+    # returns packed finals.
+    if settings.rx_kernel != "xla":
+        from rapid_tpu.engine import rx_packed
+        return rx_packed.fleet_body(rs, faults, n_ticks, settings,
+                                    fleet_mesh)
     if fleet_mesh is not None:
         f = rs.member.shape[0]
         rs = sharding_mod.fleet_axis_constrain_tree(rs, fleet_mesh, f)

@@ -147,7 +147,12 @@ def record_step(rec: RecorderState, log, settings: Settings
 
 def record_receiver_step(rec: RecorderState, log, settings: Settings
                          ) -> RecorderState:
-    """Fold one ``ReceiverStepLog`` tick into the recorder."""
+    """Fold one ``ReceiverStepLog`` tick into the recorder.
+
+    Consumes the step *log* only — never the carry — so the packed
+    receiver layouts (``Settings.rx_kernel``) ride through unchanged:
+    ``rx_packed._simulate_packed`` folds the identical log pytree the
+    dense scan emits."""
     i32 = lambda x: jnp.asarray(x).astype(jnp.int32)
     un = jnp.int32(UNOBSERVED)
     announced = jnp.asarray(log.announce, bool).any()

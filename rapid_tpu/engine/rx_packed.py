@@ -1,0 +1,320 @@
+"""Packed per-receiver scan carry: the receiver memory diet.
+
+The dense ``ReceiverState`` carry is quadratic per member with most of
+the quadratic planes boolean (``[C, C]`` seen/mask planes, ``[D, C, C]``
+wire rings, ``[C, C, K]`` reports) — one byte per bit under XLA's bool
+layout. This module re-expresses the scan carry as
+:class:`PackedReceiverState`:
+
+- every bool plane becomes a little-endian uint8 bit-plane packed along
+  its trailing slot axis (``[C, C] -> [C, ceil(C/8)]``), the same
+  ``packbits`` convention the sort-free topology machinery uses
+  (``topology._SCAN_BLOCK`` LUT blocks are 8 bits for the same reason);
+  ``reports [C, C, K]`` is transposed to ``[C, K, C]`` first so the
+  packed axis is the C-sized observer axis, not the K-sized ring axis;
+- per-slot epochs are carried as narrow deltas from a shared
+  ``epoch_base`` (the fleet-wide min, rebased at every pack). A delta
+  that does not fit ``Settings.rx_epoch_delta_bits`` is clamped AND
+  flagged sticky (``receiver.FLAG_EPOCH_DELTA_SAT``) so ``check_flags``
+  refuses the run — the fallback is explicit widening to 16-bit deltas,
+  never a silently wrong epoch;
+- ``obs_full`` (the ``[C, C, K]`` int32 observer topology, the single
+  largest dense leaf) is dropped from the carry entirely and recomputed
+  from membership at unpack: the step maintains the invariant
+  ``obs_full[r] == build_topology(member[r], ...)`` at every tick start
+  (group 12 rebuilds every row on any decide; boot broadcasts a single
+  row build), so the plane is pure derived state;
+- ``delay_table`` (read-only inside the step) leaves the carry for
+  :class:`PackedReceiverBundle` — ``lax.scan`` then treats it as a
+  closed-over constant instead of a threaded carry leaf;
+- ``pb_vrnd_r``/``pb_vrnd_i`` (classic-round numbers {0, 1, 2} and rank
+  indices < C <= receiver_capacity_cap) narrow to int8/int16 with the
+  same clamp-and-flag guard (``receiver.FLAG_PACK_NARROW_SAT``).
+
+Exactness contract: ``unpack(pack(rs)) == rs`` bit-for-bit whenever no
+saturation flag fires, and the packed scan runs the *unmodified* dense
+``receiver_step`` between unpack/pack — decisions, counters and logs are
+bit-identical to the dense scan by construction. ``Settings.rx_kernel``
+selects the layout statically; ``"xla"`` never touches this module.
+"""
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rapid_tpu.engine import receiver as receiver_mod
+from rapid_tpu.engine import recorder as recorder_mod
+from rapid_tpu.engine import sharding as sharding_mod
+from rapid_tpu.engine.state import ReceiverState
+from rapid_tpu.settings import Settings
+
+#: Dense leaves that leave the packed carry entirely.
+OMITTED_FIELDS = ("obs_full", "delay_table", "epoch")
+
+#: Bool leaves carried as packed uint8 bit-planes (trailing axis / 8).
+BIT_FIELDS = frozenset((
+    "stopped", "seen_down", "announced", "reg_valid", "px_vv_set",
+    "px_cval_set",
+    "own_fd_active", "notified", "pf",
+    "pd",
+    "w1b_set",
+    "member", "reg_mask", "vt_seen", "pb_seen", "pb_set", "p2_seen",
+    "p2_mask",
+    "wv", "w1a", "w1b", "w2a", "w2a_mask", "pd_bcast",
+    "w2b", "w2b_mask",
+    "reports",
+))
+
+#: BIT_FIELDS whose trailing (packed) axis is K-sized, not C-sized.
+_K_LAST = frozenset(("own_fd_active", "notified", "pf", "pd"))
+
+#: int32 leaves narrowed in the packed carry: name -> (dtype, lo, hi).
+NARROW_FIELDS = {
+    "pb_vrnd_r": (jnp.int8, -128, 127),
+    "pb_vrnd_i": (jnp.int16, -32768, 32767),
+}
+
+PackedReceiverState = collections.namedtuple(
+    "PackedReceiverState",
+    [f for f in ReceiverState._fields if f not in OMITTED_FIELDS]
+    + ["epoch_base", "epoch_delta"])
+
+#: The packed carry plus the scan-constant delay table (read-only in the
+#: step, so it rides outside the ``lax.scan`` carry).
+PackedReceiverBundle = collections.namedtuple(
+    "PackedReceiverBundle", ("packed", "delay_table"))
+
+#: The dense fields host-side extraction reads off a final state
+#: (``receiver_run_payload`` / ``receiver_config_ids`` / ``check_flags``)
+#: — what ``receiver.receiver_final_view`` unpacks from a packed final.
+ReceiverFinalView = collections.namedtuple(
+    "ReceiverFinalView", ("member", "stopped", "cfg_hi", "cfg_lo", "flags"))
+
+
+def _pack_bits(xp, x):
+    return xp.packbits(x, axis=-1, bitorder="little")
+
+
+def _unpack_bits(xp, x, count):
+    return xp.unpackbits(x, axis=-1, count=count,
+                         bitorder="little").astype(bool)
+
+
+def _delta_width(settings: Settings) -> Tuple[object, int]:
+    if settings.rx_epoch_delta_bits == 8:
+        return jnp.int8, 127
+    return jnp.int16, 32767
+
+
+def pack_receiver_state(rs: ReceiverState,
+                        settings: Settings) -> PackedReceiverState:
+    """Dense -> packed, clamping-and-flagging any value that does not fit
+    its narrow carry dtype (see module docstring for the exactness
+    contract)."""
+    xp = jnp
+    flags = rs.flags
+    ddtype, dmax = _delta_width(settings)
+    base = rs.epoch.min()
+    delta = rs.epoch - base
+    flags = flags | xp.where((delta > dmax).any(),
+                             receiver_mod.FLAG_EPOCH_DELTA_SAT, 0)
+    kw = {"epoch_base": base,
+          "epoch_delta": xp.clip(delta, 0, dmax).astype(ddtype)}
+    for name in PackedReceiverState._fields:
+        if name in kw:
+            continue
+        if name == "flags":
+            continue
+        leaf = getattr(rs, name)
+        if name == "reports":
+            kw[name] = _pack_bits(xp, leaf.swapaxes(-1, -2))
+        elif name in BIT_FIELDS:
+            kw[name] = _pack_bits(xp, leaf)
+        elif name in NARROW_FIELDS:
+            ndtype, lo, hi = NARROW_FIELDS[name]
+            flags = flags | xp.where(((leaf < lo) | (leaf > hi)).any(),
+                                     receiver_mod.FLAG_PACK_NARROW_SAT, 0)
+            kw[name] = xp.clip(leaf, lo, hi).astype(ndtype)
+        else:
+            kw[name] = leaf
+    kw["flags"] = flags
+    return PackedReceiverState(**kw)
+
+
+def unpack_receiver_state(ps: PackedReceiverState, delay_table,
+                          settings: Settings) -> ReceiverState:
+    """Packed -> dense, recomputing ``obs_full`` from membership (the
+    step's group-12 invariant makes the plane pure derived state)."""
+    from rapid_tpu.engine.topology import build_topology
+
+    xp = jnp
+    c = ps.member.shape[0]
+    k = ps.ring_order.shape[1]
+    kw = {"delay_table": delay_table,
+          "epoch": ps.epoch_base + ps.epoch_delta.astype(xp.int32)}
+    for name in ReceiverState._fields:
+        if name in kw or name == "obs_full":
+            continue
+        leaf = getattr(ps, name)
+        if name == "reports":
+            kw[name] = _unpack_bits(xp, leaf, c).swapaxes(-1, -2)
+        elif name in BIT_FIELDS:
+            kw[name] = _unpack_bits(xp, leaf, k if name in _K_LAST else c)
+        elif name in NARROW_FIELDS:
+            kw[name] = leaf.astype(xp.int32)
+        else:
+            kw[name] = leaf
+    kw["obs_full"] = jax.vmap(
+        lambda m: build_topology(xp, m, ps.ring_order, ps.ring_rank)[1])(
+            kw["member"])
+    return ReceiverState(**kw)
+
+
+_pack_jit = functools.partial(jax.jit, static_argnums=(1,))(
+    pack_receiver_state)
+
+
+def bundle_from_dense(rs: ReceiverState,
+                      settings: Settings) -> PackedReceiverBundle:
+    """Wrap a booted dense state as the packed scan input."""
+    return PackedReceiverBundle(packed=_pack_jit(rs, settings),
+                                delay_table=rs.delay_table)
+
+
+def as_bundle(state, settings: Settings) -> PackedReceiverBundle:
+    if isinstance(state, PackedReceiverBundle):
+        return state
+    return bundle_from_dense(state, settings)
+
+
+def final_view(ps: PackedReceiverState) -> ReceiverFinalView:
+    """Host-side dense view of a packed final (see ``ReceiverFinalView``)."""
+    c = ps.member.shape[-2]
+    member = np.unpackbits(np.asarray(ps.member), axis=-1, count=c,
+                           bitorder="little").astype(bool)
+    stopped = np.unpackbits(np.asarray(ps.stopped), axis=-1, count=c,
+                            bitorder="little").astype(bool)
+    return ReceiverFinalView(member=member, stopped=stopped,
+                             cfg_hi=np.asarray(ps.cfg_hi),
+                             cfg_lo=np.asarray(ps.cfg_lo),
+                             flags=np.asarray(ps.flags))
+
+
+# --- sizing --------------------------------------------------------------
+
+def abstract_dense_state(capacity: int, settings: Settings) -> ReceiverState:
+    """A ``ShapeDtypeStruct`` skeleton of the dense per-member state —
+    the input the packed byte accounting runs ``jax.eval_shape`` over, so
+    the reported bytes come from the *actual* pack function and cannot
+    drift from the layout."""
+    shapes = receiver_mod.receiver_field_shapes(
+        capacity, settings.K, ring_depth=settings.delivery_ring_depth)
+    return ReceiverState(**{
+        name: jax.ShapeDtypeStruct(shape, jnp.bool_ if item == 1
+                                   else jnp.int32)
+        for name, (shape, item) in shapes.items()})
+
+
+def _tree_bytes(tree) -> int:
+    return sum(int(np.prod(leaf.shape, dtype=np.int64)) * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+@functools.lru_cache(maxsize=None)
+def dense_state_bytes(capacity: int, settings: Settings) -> int:
+    """Exact bytes of one dense carry, from the abstract boot skeleton
+    (equals ``receiver.receiver_state_bytes`` — asserted by the budget
+    check so the shape table cannot drift)."""
+    return _tree_bytes(abstract_dense_state(capacity, settings))
+
+
+@functools.lru_cache(maxsize=None)
+def packed_state_bytes(capacity: int, settings: Settings) -> int:
+    """Exact bytes of one packed carry (``PackedReceiverState``), derived
+    by tracing ``pack_receiver_state`` over the abstract dense state."""
+    dense = abstract_dense_state(capacity, settings)
+    packed = jax.eval_shape(
+        functools.partial(pack_receiver_state, settings=settings), dense)
+    return _tree_bytes(packed)
+
+
+@functools.lru_cache(maxsize=None)
+def bundle_state_bytes(capacity: int, settings: Settings) -> int:
+    """Exact per-member bytes of the packed scan input: the packed carry
+    plus the scan-constant delay table."""
+    dense = abstract_dense_state(capacity, settings)
+    return packed_state_bytes(capacity, settings) + _tree_bytes(
+        dense.delay_table)
+
+
+# --- packed scan ---------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _simulate_packed(bundle: PackedReceiverBundle, faults, n_ticks: int,
+                     settings: Settings, dense_final: bool):
+    """The packed twin of ``receiver._simulate``: unpack -> the unmodified
+    dense ``receiver_step`` -> repack, each tick. Only the packed carry
+    crosses scan iterations, so the persistent working set is the diet
+    figure; the dense state is a per-tick temporary. ``dense_final``
+    (static) unpacks the final carry inside the jit — the single-member
+    drop-in used by ``diff.run_receiver_differential``."""
+    delay_table = bundle.delay_table
+
+    def step(ps, _):
+        rs = unpack_receiver_state(ps, delay_table, settings)
+        nxt, log = receiver_mod.receiver_step(rs, faults, settings)
+        return pack_receiver_state(nxt, settings), log
+
+    if settings.flight_recorder_window:
+        def rec_body(carry, _):
+            st, rec = carry
+            nxt, log = step(st, None)
+            return (nxt, recorder_mod.record_receiver_step(
+                rec, log, settings)), log
+
+        (final, rec), logs = lax.scan(
+            rec_body, (bundle.packed, recorder_mod.init(settings)), None,
+            length=n_ticks)
+        if dense_final:
+            final = unpack_receiver_state(final, delay_table, settings)
+        return final, logs, rec
+
+    final, logs = lax.scan(step, bundle.packed, None, length=n_ticks)
+    if dense_final:
+        final = unpack_receiver_state(final, delay_table, settings)
+    return final, logs
+
+
+def simulate(state, faults, n_ticks: int, settings: Settings):
+    """Single-member packed scan returning a *dense* final state (plus
+    logs, plus the recorder ring when enabled) — a drop-in for the dense
+    ``receiver_simulate`` contract. ``state`` may be a booted dense
+    ``ReceiverState`` or an already-packed bundle."""
+    return _simulate_packed(as_bundle(state, settings), faults,
+                            int(n_ticks), settings, True)
+
+
+def fleet_body(bundle, faults, n_ticks: int, settings: Settings,
+               fleet_mesh=None):
+    """The packed twin of ``receiver._fleet_body`` — finals stay *packed*
+    (the memory diet applies to dispatch outputs too); hosts fold them
+    via ``receiver.receiver_final_view``."""
+    if fleet_mesh is not None:
+        f = bundle.packed.member.shape[0]
+        bundle = sharding_mod.fleet_axis_constrain_tree(
+            bundle, fleet_mesh, f)
+        faults = sharding_mod.fleet_axis_constrain_tree(
+            faults, fleet_mesh, f)
+    sim = lambda b, f_: _simulate_packed(b, f_, n_ticks, settings, False)
+    outs = jax.vmap(sim)(bundle, faults)
+    if fleet_mesh is not None:
+        outs = tuple(sharding_mod.fleet_axis_constrain_tree(
+            o, fleet_mesh, f) for o in outs)
+    return outs

@@ -1,0 +1,94 @@
+"""Hand-written pallas kernel for the receiver deliver/aggregate loop.
+
+``receiver_step`` calls ``_account`` once per delivery group — the hot
+loop of the per-receiver engine: elementwise bool algebra over ``[C, C]``
+message planes plus three full-plane popcount reductions. Under
+``Settings.rx_kernel = "pallas"`` that loop runs here instead, over
+*packed* operands:
+
+- ``pm``   uint8 ``[C, ceil(C/8)]`` — the message plane, packed
+  little-endian along the dst axis (bit ``d`` of byte ``b`` in row ``s``
+  is ``msgs[s, 8*b + d]``);
+- ``pe``   uint8 ``[C, ceil(C/8)]`` — the blocked-edge plane from
+  ``monitor.link_blocked_packed`` (same layout; no dense ``[C, C]``
+  reachability plane is ever materialized on this path);
+- ``src``  uint8 ``[C, 1]`` — 0xFF where the sender is alive, else 0
+  (a crashed *sender* kills its whole row);
+- ``pd``   uint8 ``[1, ceil(C/8)]`` — the alive-receiver bitmask
+  (a crashed *receiver* kills its column).
+
+The kernel computes ``ok = pm & src & pd`` then splits it against the
+blocked plane — ``deliv = ok & ~pe``, ``linkd = ok & pe`` — and reduces
+per-row popcounts with the classic SWAR ladder (add-shift-mask, no
+lookup table: uint8 lanes stay uint8-wide in VMEM). One fused pass,
+bitwise ops over packed uint8 tiles — exactly the shape pallas wins on.
+
+Exactness: the padding bits (when C % 8 != 0) are provably zero in every
+operand (``packbits`` zero-pads; the blocked plane inherits zero pads
+from its dst packbits), so ``deliv``'s pads are zero and the popcounts
+equal the dense ``.sum()`` counts bit-for-bit; ``dropped`` is recovered
+as ``popcount(pm) - popcount(deliv)`` (valid because ``deliv`` is a
+subset of ``pm``), matching the dense ``(msgs & ~deliv).sum()``. All
+counts are int32, the dense ``_account`` dtypes.
+
+CI story: off-TPU the kernel runs under ``interpret=True`` (pallas
+lowers it with jax ops, still one traced call site), so tier-1 exercises
+the exact kernel program bit-for-bit on CPU; on TPU it compiles to
+Mosaic. The jaxpr guard in ``tests/test_rx_packed.py`` pins that the
+kernel's own jaxpr contains no dense ``[C, C]`` intermediate and that
+``rx_kernel = "xla"`` traces zero pallas calls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _popcount_rows(bytes_u8):
+    """Per-row popcount of a uint8 plane via the SWAR ladder."""
+    v = bytes_u8
+    v = v - ((v >> 1) & 0x55)
+    v = (v & 0x33) + ((v >> 2) & 0x33)
+    v = (v + (v >> 4)) & 0x0F
+    return v.astype(jnp.int32).sum(axis=1)
+
+
+def _account_kernel(pm_ref, pe_ref, src_ref, pd_ref, dv_ref, cnt_ref):
+    pm = pm_ref[...]
+    ok = pm & src_ref[...] & pd_ref[...]
+    pe = pe_ref[...]
+    dv = ok & ~pe
+    dv_ref[...] = dv
+    pad = jnp.zeros(pm.shape[:1], jnp.int32)
+    cnt_ref[...] = jnp.stack(
+        [_popcount_rows(pm), _popcount_rows(dv), _popcount_rows(ok & pe),
+         pad], axis=1)
+
+
+def account(msgs, crashed, pemat):
+    """Packed-plane twin of ``receiver._account``: delivery mask plus
+    (delivered, dropped, link_dropped) int32 counts, bit-identical to the
+    dense path. ``pemat`` is the packed blocked plane
+    (``monitor.link_blocked_packed``)."""
+    c = msgs.shape[0]
+    pm = jnp.packbits(msgs, axis=-1, bitorder="little")
+    src = jnp.where(crashed, jnp.uint8(0), jnp.uint8(0xFF))[:, None]
+    pdst = jnp.packbits(~crashed, bitorder="little")[None, :]
+    cb = pm.shape[1]
+    dv_p, cnt = pl.pallas_call(
+        _account_kernel,
+        out_shape=(jax.ShapeDtypeStruct((c, cb), jnp.uint8),
+                   jax.ShapeDtypeStruct((c, 4), jnp.int32)),
+        interpret=_interpret(),
+    )(pm, pemat, src, pdst)
+    deliv = jnp.unpackbits(dv_p, axis=-1, count=c,
+                           bitorder="little").astype(bool)
+    total = cnt[:, 0].sum()
+    delivered = cnt[:, 1].sum()
+    linkd = cnt[:, 2].sum()
+    return deliv, delivered, total - delivered, linkd

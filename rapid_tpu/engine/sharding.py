@@ -81,6 +81,11 @@ def spec_for(shape: Sequence[int], capacity: int, mesh):
     capacity axis does not divide the mesh — sharding would force uneven
     padding and XLA reshards mid-step. Scalars, the ``[256, 8]`` scan
     LUTs, and per-instance fallback tables never match and replicate.
+
+    Packed receiver planes (``rx_packed``) need no special casing: the
+    bit-packing shrinks only the *trailing* axis (``[C, C] ->
+    [C, C/8]``), so the leading capacity-sized slot axis this spec keys
+    on is untouched and packed leaves shard exactly like dense ones.
     """
     from jax.sharding import PartitionSpec as P
 

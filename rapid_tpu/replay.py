@@ -113,7 +113,13 @@ def replay_member(payload: Dict[str, object], dispatch: int,
 
     # The deterministic chain, replayed verbatim from run_campaign:
     # sample -> route -> pools -> chunk plan. Same seed, same plan.
+    # rx_kernel is echoed in the payload: replaying a packed/pallas
+    # campaign on the dense layout would re-lower a different member
+    # program and break the bit-identical-fold contract.
     base = Settings()
+    rx_kernel = camp["per_receiver"].get("rx_kernel", "xla")
+    if rx_kernel != "xla":
+        base = base.with_(rx_kernel=rx_kernel)
     c = cfg.n + cfg.headroom
     settings = base.with_(capacity=c)
     rx_settings = base.with_(capacity=cfg.n)
@@ -185,7 +191,10 @@ def replay_member(payload: Dict[str, object], dispatch: int,
             finals, logs = result
         jax.block_until_ready(logs)
         import numpy as np
-        mrs = jax.tree_util.tree_map(lambda x: x[0], finals)
+        # Packed fleets return PackedReceiverState finals; the view shim
+        # unpacks the handful of fields the fold reads (no-op on dense).
+        mrs = receiver_mod.receiver_final_view(
+            jax.tree_util.tree_map(lambda x: x[0], finals))
         mlog = jax.tree_util.tree_map(lambda x: x[0], logs)
         run = receiver_mod.receiver_run_payload(mrs, mlog, cfg.n,
                                                 cfg.ticks)

@@ -58,8 +58,34 @@ class Settings:
     # report/topology tensors — ``receiver.receiver_state_bytes`` sizes
     # it exactly), so campaigns refuse oversized fleets up front with a
     # structured ``fleet.ReceiverBudgetError`` instead of letting the
-    # device OOM mid-campaign.
-    receiver_capacity_cap: int = 1024
+    # device OOM mid-campaign. The packed carry (``rx_kernel`` below)
+    # pays a fraction of the dense bytes per member, which is what makes
+    # the 4096 default honest; ``fleet.check_receiver_budget`` reports
+    # both figures on refusal.
+    receiver_capacity_cap: int = 4096
+
+    # Receiver scan-carry layout and deliver/aggregate kernel. Static —
+    # flipping it retraces:
+    #   "xla"    — the historical dense carry and XLA deliver loop; the
+    #              traced jaxpr is byte-identical to the pre-knob engine.
+    #   "packed" — bool planes carried as little-endian uint8 bit-planes
+    #              ([C, C] -> [C, ceil(C/8)]), epochs as deltas from a
+    #              shared base, obs_full recomputed from membership
+    #              (``engine.rx_packed``). Bit-identical by construction:
+    #              each tick unpacks, runs the unmodified dense step, and
+    #              repacks.
+    #   "pallas" — packed carry plus a hand-written pallas kernel for the
+    #              deliver/aggregate hot loop over the packed planes and
+    #              lazy per-edge link-window reachability (no [C, C]
+    #              reachability plane is materialized). Runs in interpret
+    #              mode off-TPU so CI exercises it bit-for-bit.
+    rx_kernel: str = "xla"
+
+    # Width of the packed per-slot epoch deltas (8 or 16). Deltas that
+    # would saturate the narrow dtype are clamped AND flagged
+    # (``receiver.FLAG_EPOCH_DELTA_SAT``), so the fallback is explicit:
+    # rerun with rx_epoch_delta_bits=16 — never silently wrong.
+    rx_epoch_delta_bits: int = 8
 
     # Depth D of the per-receiver in-flight delivery ring: wire tensors
     # carry a leading [D] axis indexed by arrival tick, so the largest
@@ -102,6 +128,14 @@ class Settings:
             raise ValueError(
                 f"flight_recorder_window must be >= 0, got "
                 f"{self.flight_recorder_window}")
+        if self.rx_kernel not in ("xla", "packed", "pallas"):
+            raise ValueError(
+                f"rx_kernel must be one of 'xla', 'packed', 'pallas', "
+                f"got {self.rx_kernel!r}")
+        if self.rx_epoch_delta_bits not in (8, 16):
+            raise ValueError(
+                f"rx_epoch_delta_bits must be 8 or 16, got "
+                f"{self.rx_epoch_delta_bits}")
 
     def with_(self, **kw) -> "Settings":
         return replace(self, **kw)

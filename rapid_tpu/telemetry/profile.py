@@ -391,26 +391,47 @@ def receiver_memory_block(settings, n: int = 64,
     ``per_receiver`` payload block. ``member_state_bytes`` is the
     analytic per-member figure (``receiver.receiver_state_bytes``) the
     measured argument bytes should roughly ``F``-multiply.
+
+    Alongside the dense measurement, the block carries a ``packed``
+    twin — the same fleet widths lowered over the packed bit-plane carry
+    (``engine.rx_packed``, the ``Settings.rx_kernel != "xla"`` scan
+    body: unpack -> ``receiver_step`` -> repack) — plus an analytic
+    ``bytes_per_member_curve`` over campaign-relevant capacities so the
+    dense-vs-packed ratio is visible without re-measuring. Curve bytes
+    come from ``jax.eval_shape`` over the *actual* pack function, not a
+    hand-maintained table.
     """
     import jax
 
     from rapid_tpu.engine import receiver as receiver_mod
+    from rapid_tpu.engine import rx_packed
     from rapid_tpu.engine.fleet import (lower_receiver_schedule,
                                         stack_receiver_members)
     from rapid_tpu.faults import ScenarioWeights, sample_adversary_schedule
 
+    dense_settings = settings if settings.rx_kernel == "xla" \
+        else settings.with_(rx_kernel="xla")
+    packed_settings = settings if settings.rx_kernel != "xla" \
+        else settings.with_(rx_kernel="packed")
     weights = ScenarioWeights(crash=0.0, partition=1.0, flip_flop=0.0,
                               contested=0.0, churn=0.0)
     sc = sample_adversary_schedule(n, seed, 8 * settings.fd_interval_ticks,
                                    weights)
-    member = lower_receiver_schedule(sc.schedule, settings,
+    member = lower_receiver_schedule(sc.schedule, dense_settings,
                                      fleet_size=max(fleet_sizes))
     c = int(member.state.member.shape[0])
 
     def one_tick(state, faults):
-        return receiver_mod.receiver_step(state, faults, settings)
+        return receiver_mod.receiver_step(state, faults, dense_settings)
+
+    def packed_tick(bundle, faults):
+        rs = rx_packed.unpack_receiver_state(
+            bundle.packed, bundle.delay_table, packed_settings)
+        nxt, log = receiver_mod.receiver_step(rs, faults, packed_settings)
+        return rx_packed.pack_receiver_state(nxt, packed_settings), log
 
     fleets: List[Dict[str, object]] = []
+    packed_fleets: List[Dict[str, object]] = []
     for f in fleet_sizes:
         fleet = stack_receiver_members([member] * f)
         t0 = time.perf_counter()
@@ -420,13 +441,44 @@ def receiver_memory_block(settings, n: int = 64,
         mem = compiled_memory_stats(compiled)
         fleets.append({"fleet_size": f, **mem,
                        "compile_s": round(compile_s, 6)})
+
+        pstate = jax.vmap(
+            lambda rs: rx_packed.pack_receiver_state(rs, packed_settings))(
+                fleet.state)
+        bundle = rx_packed.PackedReceiverBundle(
+            packed=pstate, delay_table=fleet.state.delay_table)
+        t0 = time.perf_counter()
+        compiled_p = jax.jit(jax.vmap(packed_tick)).lower(
+            bundle, fleet.faults).compile()
+        compile_p_s = time.perf_counter() - t0
+        mem_p = compiled_memory_stats(compiled_p)
+        packed_fleets.append({"fleet_size": f, **mem_p,
+                              "compile_s": round(compile_p_s, 6)})
+
+    curve: List[Dict[str, object]] = []
+    for cc in (64, 256, 1024, 4096):
+        dense_b = rx_packed.dense_state_bytes(cc, dense_settings)
+        packed_b = rx_packed.packed_state_bytes(cc, packed_settings)
+        bundle_b = rx_packed.bundle_state_bytes(cc, packed_settings)
+        curve.append({
+            "capacity": cc,
+            "dense_bytes": dense_b,
+            "packed_carry_bytes": packed_b,
+            "packed_bundle_bytes": bundle_b,
+            "carry_reduction": round(dense_b / packed_b, 2),
+            "bundle_reduction": round(dense_b / bundle_b, 2),
+        })
     return {
         "n": n,
         "capacity": c,
         "k": settings.K,
         "member_state_bytes": receiver_mod.receiver_state_bytes(
-            c, settings.K),
+            c, settings.K, ring_depth=settings.delivery_ring_depth),
+        "member_state_bytes_packed": rx_packed.bundle_state_bytes(
+            c, packed_settings),
         "fleets": fleets,
+        "packed_fleets": packed_fleets,
+        "bytes_per_member_curve": curve,
     }
 
 

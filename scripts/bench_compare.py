@@ -247,6 +247,22 @@ def compare_profile_sweeps(current: Dict, baseline: Dict,
                     f"--update-baseline")
         base_fleets = {f.get("fleet_size"): f
                        for f in base_rm.get("fleets", [])}
+        # The packed layout's analytic curve is shape arithmetic too —
+        # dense/packed bytes per member must diff exactly (this is the
+        # memory-diet claim the README table cites); absent on either
+        # side means a pre-diet payload, which is fine.
+        cur_curve = {row.get("capacity"): row
+                     for row in cur_rm.get("bytes_per_member_curve", [])}
+        base_curve = {row.get("capacity"): row
+                      for row in base_rm.get("bytes_per_member_curve", [])}
+        for cap in sorted(set(cur_curve) & set(base_curve)):
+            for key in ("dense_bytes", "packed_carry_bytes",
+                        "packed_bundle_bytes"):
+                if cur_curve[cap].get(key) != base_curve[cap].get(key):
+                    errors.append(
+                        f"payload.receiver_memory.bytes_per_member_curve"
+                        f"[C={cap}].{key}: {cur_curve[cap].get(key)!r} != "
+                        f"baseline {base_curve[cap].get(key)!r}")
         for fl in cur_rm.get("fleets", []):
             fsz = fl.get("fleet_size")
             where = f"payload.receiver_memory.fleets[F={fsz}]"

@@ -57,6 +57,40 @@ def _rate(entry: Optional[Dict], key: str) -> Optional[float]:
     return value if isinstance(value, (int, float)) else None
 
 
+def _rx_rate(fleet: Optional[Dict]) -> Optional[float]:
+    """Per-receiver engine throughput in member-ticks/sec, folded from
+    the campaign's dispatch timeline: every member of a per_receiver
+    dispatch advances ``ticks`` protocol ticks during that dispatch's
+    ``execute`` stage, so the rate is sum(members * ticks) over
+    sum(execute walls). None when the payload predates the timeline or
+    ran no per-receiver dispatches."""
+    if not isinstance(fleet, dict):
+        return None
+    ticks = fleet.get("ticks")
+    timeline = fleet.get("dispatch_timeline")
+    if not isinstance(ticks, (int, float)) or \
+            not isinstance(timeline, list):
+        return None
+    member_ticks = 0.0
+    execute_s = 0.0
+    for rec in timeline:
+        if not isinstance(rec, dict) or rec.get("mode") != "per_receiver":
+            continue
+        members = rec.get("members")
+        stages = rec.get("stages")
+        if not isinstance(members, (int, float)) or \
+                not isinstance(stages, dict):
+            continue
+        wall = stages.get("execute")
+        if not isinstance(wall, (int, float)):
+            continue
+        member_ticks += members * ticks
+        execute_s += wall
+    if member_ticks <= 0 or execute_s <= 0:
+        return None
+    return member_ticks / execute_s
+
+
 def _fold_bench(path: str) -> Dict[str, object]:
     """One BENCH_rNN.json -> a trend row (never raises: unreadable
     records become dead rows, which is exactly what we report)."""
@@ -98,6 +132,7 @@ def _fold_bench(path: str) -> Dict[str, object]:
                     for name in RATE_ENTRIES}
     row["clusters_per_sec"] = _rate(parsed.get("fleet"),
                                     "clusters_per_sec")
+    row["rx_member_ticks_per_sec"] = _rx_rate(parsed.get("fleet"))
     return row
 
 
@@ -132,6 +167,7 @@ def _baseline_row(path: str) -> Optional[Dict[str, object]]:
                       for name in RATE_ENTRIES},
             "clusters_per_sec": _rate(baseline.get("fleet"),
                                       "clusters_per_sec"),
+            "rx_member_ticks_per_sec": _rx_rate(baseline.get("fleet")),
             "problems": []}
 
 
@@ -161,7 +197,7 @@ def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
 def render(report: Dict[str, object]) -> str:
     lines = []
     header = (["round", "rc"] + list(RATE_ENTRIES)
-              + ["fleet cl/s", "flags"])
+              + ["fleet cl/s", "rx mt/s", "flags"])
     rows: List[List[str]] = []
     baseline = report["baseline"]
     for row in ([baseline] if baseline else []) + list(report["rounds"]):
@@ -171,7 +207,8 @@ def render(report: Dict[str, object]) -> str:
         rows.append([label, str(row["rc"])]
                     + [_fmt(row["rates"].get(name))
                        for name in RATE_ENTRIES]
-                    + [_fmt(row["clusters_per_sec"]), flags])
+                    + [_fmt(row["clusters_per_sec"]),
+                       _fmt(row.get("rx_member_ticks_per_sec")), flags])
     widths = [max(len(header[i]), *(len(r[i]) for r in rows))
               if rows else len(header[i]) for i in range(len(header))]
     lines.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))

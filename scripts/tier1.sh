@@ -232,6 +232,77 @@ sys.exit(0 if ok else 1)'; then
     fi
 fi
 
+# Packed-receiver smoke: the same N=64 per-receiver campaign on the
+# packed bit-plane layout (--rx-kernel packed). Spot checks replay
+# through run_receiver_differential with the campaign's own settings,
+# so the host referee bit-compares the packed device run; the payload
+# must echo the layout and show the diet (packed member bytes strictly
+# below the dense figure it also echoes).
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 6 --fleet-size 6 --n 64 --ticks 160 \
+            --rx-kernel packed --spot-checks 1 \
+            --out /tmp/_t1_rxpacked.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_rxpacked.json \
+        && python -c '
+import json, sys
+camp = json.load(open("/tmp/_t1_rxpacked.json"))["campaign"]
+pr = camp["per_receiver"]
+spot = camp["spot_checks"]["members"]
+ok = (pr["enabled"] and pr["rx_kernel"] == "packed"
+      and pr["member_state_bytes"] < pr["member_state_bytes_unpacked"]
+      and any(m["mode"] == "per_receiver" and m["passed"] for m in spot))
+sys.exit(0 if ok else 1)'; then
+        echo RX_PACKED_SMOKE=ok
+    else
+        echo RX_PACKED_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Pallas-kernel smoke: one delay+partition member under
+# rx_kernel="pallas" (the packed carry plus the pallas deliver/
+# aggregate kernel, interpreted on CPU) must be bit-identical to the
+# dense XLA run — finals, logs and flags — at N=64. This is the
+# device-exactness gate for the hand-written kernel.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -c '
+import numpy as np
+from rapid_tpu.engine import fleet as fleet_mod
+from rapid_tpu.engine import receiver as rx_mod
+from rapid_tpu.faults import AdversarySchedule, DelayRule, LinkWindow
+from rapid_tpu.settings import Settings
+
+n = 64
+sched = AdversarySchedule(
+    n=n,
+    windows=(LinkWindow(src_slots=frozenset(range(8)),
+                        dst_slots=frozenset(range(8, n)),
+                        start_tick=20, end_tick=60, two_way=True),),
+    delays=(DelayRule(src_slots=frozenset(range(0, 16)),
+                      dst_slots=frozenset(range(16, 40)),
+                      delay_ticks=1, jitter_ticks=2,
+                      start_tick=5, end_tick=70),),
+    seed=11)
+xla = Settings()
+member = fleet_mod.lower_receiver_schedule(sched, xla)
+want_final, want_logs = rx_mod.receiver_simulate(
+    member.state, member.faults, 80, xla)
+got_final, got_logs = rx_mod.receiver_simulate(
+    member.state, member.faults, 80, xla.with_(rx_kernel="pallas"))
+for a, b in ((got_final, want_final), (got_logs, want_logs)):
+    for field, x, y in zip(type(a)._fields, a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), field
+rx_mod.check_flags(int(np.asarray(got_final.flags)))
+print("pallas bit-identical over", len(want_logs._fields), "log fields")
+'; then
+        echo RX_PALLAS_SMOKE=ok
+    else
+        echo RX_PALLAS_SMOKE=failed
+        rc=1
+    fi
+fi
+
 # Triage + replay smoke: a recorder-on campaign must emit a schema-v8
 # triage block that flags at least one member with a full exemplar
 # (expected fold + flight-recorder ring), and `python -m

@@ -1,0 +1,306 @@
+"""Receiver memory diet: packed bit-plane carry + pallas hot loop.
+
+Pins the PR's exactness contract (ISSUE 16):
+
+- ``pack -> unpack`` is a bit-exact round trip on random planes AND on
+  real booted/stepped receiver states (``obs_full`` recomputed from the
+  group-12 invariant, epochs rebased through the shared-base delta);
+- epoch-delta saturation clamps AND flags (never silently wrong), and
+  widening to 16-bit deltas is the documented escape hatch;
+- ``rx_kernel="packed"`` / ``"pallas"`` scans are bit-identical to the
+  dense ``"xla"`` scan — finals, logs, flags — including a member that
+  combines a two-way partition window with delay+jitter rules;
+- the default path traces zero pallas calls and the pallas kernel's own
+  jaxpr holds no dense ``[C, C]`` intermediate;
+- the budget gate sizes the *actual* lowered pytree: analytic bytes
+  match XLA's measured argument bytes within 1%, and the structured
+  error carries both packed and unpacked figures.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rapid_tpu.engine import fleet as fleet_mod
+from rapid_tpu.engine import receiver as rx_mod
+from rapid_tpu.engine import rx_packed, rx_pallas
+from rapid_tpu.engine.diff import run_receiver_differential
+from rapid_tpu.faults import (SCENARIO_KINDS, AdversarySchedule, DelayRule,
+                              LinkWindow, ScenarioWeights,
+                              sample_adversary_schedule)
+from rapid_tpu.settings import Settings
+
+SETTINGS = Settings()
+PACKED = SETTINGS.with_(rx_kernel="packed")
+PALLAS = SETTINGS.with_(rx_kernel="pallas")
+
+
+def _assert_tree_equal(a, b, what):
+    for field, x, y in zip(type(a)._fields, a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{what}: field {field} diverged"
+
+
+def _delay_partition_schedule(n=16, seed=11):
+    """A member combining a two-way partition window with delay+jitter
+    rules — the adversary mix the pallas acceptance gate names."""
+    return AdversarySchedule(
+        n=n,
+        windows=(LinkWindow(src_slots=frozenset(range(4)),
+                            dst_slots=frozenset(range(4, n)),
+                            start_tick=10, end_tick=40, two_way=True),),
+        delays=(DelayRule(src_slots=frozenset(range(0, n // 2)),
+                          dst_slots=frozenset(range(n // 2, n)),
+                          delay_ticks=1, jitter_ticks=2,
+                          start_tick=5, end_tick=50),),
+        seed=seed)
+
+
+def _booted(n=12, seed=0):
+    weights = ScenarioWeights(
+        **{k: (1.0 if k == "partition" else 0.0) for k in SCENARIO_KINDS})
+    sc = sample_adversary_schedule(n, seed, 80, weights)
+    return fleet_mod.lower_receiver_schedule(sc.schedule, SETTINGS)
+
+
+# ---------------------------------------------------------------------------
+# pack/unpack round trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(12,), (3, 16), (5, 7, 13), (4, 64)])
+def test_pack_bits_round_trip_random(shape):
+    rng = np.random.default_rng(sum(shape))
+    x = jnp.asarray(rng.integers(0, 2, size=shape, dtype=np.uint8)
+                    .astype(bool))
+    packed = rx_packed._pack_bits(jnp, x)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape == shape[:-1] + (-(-shape[-1] // 8),)
+    back = rx_packed._unpack_bits(jnp, packed, shape[-1])
+    assert np.array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_pack_unpack_round_trip_booted_and_stepped():
+    """Every field, dtype and shape of the dense state survives a packed
+    round trip — on the boot state and after real protocol ticks (the
+    group-12 ``obs_full`` invariant is what makes the plane droppable)."""
+    member = _booted()
+    rs = member.state
+    for label, state in (("boot", rs),):
+        ps = rx_packed.pack_receiver_state(state, SETTINGS)
+        back = rx_packed.unpack_receiver_state(ps, state.delay_table,
+                                               SETTINGS)
+        _assert_tree_equal(back, state, f"{label} round trip")
+        for field, leaf in zip(type(back)._fields, back):
+            want = np.asarray(getattr(state, field))
+            assert np.asarray(leaf).dtype == want.dtype, field
+    final, _ = rx_mod.receiver_simulate(rs, member.faults, 48, SETTINGS)
+    ps = rx_packed.pack_receiver_state(final, SETTINGS)
+    back = rx_packed.unpack_receiver_state(ps, final.delay_table, SETTINGS)
+    _assert_tree_equal(back, final, "stepped round trip")
+
+
+def test_packed_carry_is_actually_smaller():
+    for c in (64, 256, 1024, 4096):
+        dense = rx_packed.dense_state_bytes(c, SETTINGS)
+        carry = rx_packed.packed_state_bytes(c, SETTINGS)
+        bundle = rx_packed.bundle_state_bytes(c, SETTINGS)
+        assert carry < bundle < dense
+        assert dense / carry > 3.0, f"C={c}: carry diet regressed"
+        assert dense / bundle > 2.5, f"C={c}: bundle diet regressed"
+
+
+# ---------------------------------------------------------------------------
+# saturation guards: clamp AND flag, never silently wrong
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_delta_saturation_flags_and_widening():
+    member = _booted()
+    rs = member.state
+    # exactly at the int8 ceiling: no flag, exact round trip
+    edge = rs._replace(epoch=rs.epoch.at[0].set(rs.epoch.min() + 127))
+    ps = rx_packed.pack_receiver_state(edge, SETTINGS)
+    assert ps.epoch_delta.dtype == jnp.int8
+    assert int(ps.flags) & rx_mod.FLAG_EPOCH_DELTA_SAT == 0
+    back = rx_packed.unpack_receiver_state(ps, rs.delay_table, SETTINGS)
+    assert np.array_equal(np.asarray(back.epoch), np.asarray(edge.epoch))
+    # one past the ceiling: clamped AND flagged sticky
+    over = rs._replace(epoch=rs.epoch.at[0].set(rs.epoch.min() + 128))
+    ps = rx_packed.pack_receiver_state(over, SETTINGS)
+    assert int(ps.flags) & rx_mod.FLAG_EPOCH_DELTA_SAT
+    with pytest.raises(rx_mod.ReceiverEnvelopeError,
+                       match="epoch-delta-saturated"):
+        rx_mod.check_flags(int(ps.flags))
+    # the documented fallback: widen to 16-bit deltas — flag clears and
+    # the round trip is exact again
+    wide = SETTINGS.with_(rx_epoch_delta_bits=16)
+    ps = rx_packed.pack_receiver_state(over, wide)
+    assert ps.epoch_delta.dtype == jnp.int16
+    assert int(ps.flags) & rx_mod.FLAG_EPOCH_DELTA_SAT == 0
+    back = rx_packed.unpack_receiver_state(ps, rs.delay_table, wide)
+    assert np.array_equal(np.asarray(back.epoch), np.asarray(over.epoch))
+
+
+def test_narrow_field_saturation_flags():
+    member = _booted()
+    rs = member.state
+    bad = rs._replace(pb_vrnd_i=rs.pb_vrnd_i.at[0].set(40000))
+    ps = rx_packed.pack_receiver_state(bad, SETTINGS)
+    assert int(ps.flags) & rx_mod.FLAG_PACK_NARROW_SAT
+    with pytest.raises(rx_mod.ReceiverEnvelopeError,
+                       match="packed-narrow-overflow"):
+        rx_mod.check_flags(int(ps.flags))
+    names = rx_mod.decode_flags(rx_mod.FLAG_EPOCH_DELTA_SAT
+                                | rx_mod.FLAG_PACK_NARROW_SAT)
+    assert "epoch-delta-saturated" in names
+    assert "packed-narrow-overflow" in names
+
+
+# ---------------------------------------------------------------------------
+# jaxpr guards
+# ---------------------------------------------------------------------------
+
+
+def test_xla_mode_traces_zero_pallas_calls():
+    member = _booted()
+    jaxpr = jax.make_jaxpr(
+        lambda s, f: rx_mod.receiver_step(s, f, SETTINGS))(
+            member.state, member.faults)
+    assert "pallas" not in str(jaxpr)
+
+
+def test_pallas_mode_traces_the_kernel():
+    member = _booted()
+    jaxpr = jax.make_jaxpr(
+        lambda s, f: rx_mod.receiver_step(s, f, PALLAS))(
+            member.state, member.faults)
+    assert "pallas_call" in str(jaxpr)
+
+
+def test_pallas_kernel_jaxpr_has_no_dense_plane():
+    """The kernel's own program works on packed ``[C, C/8]`` uint8 tiles:
+    no ``[C, C]`` intermediate may appear inside the pallas_call."""
+    c = 64
+    msgs = jnp.zeros((c, c), bool)
+    crashed = jnp.zeros((c,), bool)
+    pemat = jnp.zeros((c, c // 8), jnp.uint8)
+    jaxpr = jax.make_jaxpr(rx_pallas.account)(msgs, crashed, pemat)
+    calls = [e for e in jaxpr.eqns if "pallas" in e.primitive.name]
+    assert len(calls) == 1
+    inner = str(calls[0].params["jaxpr"])
+    assert f"{c},{c}]" not in inner, "dense [C,C] plane inside the kernel"
+    assert f"{c},{c // 8}]" in inner
+
+
+# ---------------------------------------------------------------------------
+# scan bit-identity: packed and pallas vs the dense reference
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("settings", [PACKED, PALLAS],
+                         ids=["packed", "pallas"])
+def test_scan_bit_identical_to_dense(settings):
+    sched = _delay_partition_schedule()
+    member = fleet_mod.lower_receiver_schedule(sched, SETTINGS)
+    want_final, want_logs = rx_mod.receiver_simulate(
+        member.state, member.faults, 60, SETTINGS)
+    got_final, got_logs = rx_mod.receiver_simulate(
+        member.state, member.faults, 60, settings)
+    _assert_tree_equal(got_final, want_final, "final state")
+    _assert_tree_equal(got_logs, want_logs, "logs")
+    rx_mod.check_flags(int(np.asarray(got_final.flags)))
+
+
+def test_packed_differential_device_exact():
+    """The oracle referee holds through the packed layout too."""
+    sched = _delay_partition_schedule()
+    result = run_receiver_differential(sched, 60, PACKED)
+    result.assert_identical()
+
+
+def test_fleet_returns_packed_finals_and_view_folds():
+    """Packed dispatches keep their finals packed (the diet applies to
+    outputs); ``receiver_final_view`` recovers exactly the fields the
+    host fold reads, equal to the dense run's."""
+    sched = _delay_partition_schedule()
+    dense_member = fleet_mod.lower_receiver_schedule(sched, SETTINGS)
+    want_final, want_logs = rx_mod.receiver_simulate(
+        dense_member.state, dense_member.faults, 60, SETTINGS)
+
+    member = fleet_mod.lower_receiver_schedule(sched, PACKED)
+    assert isinstance(member.state, rx_packed.PackedReceiverBundle)
+    fleet = fleet_mod.stack_receiver_members([member])
+    finals, logs = fleet_mod.receiver_fleet_simulate(fleet, 60, PACKED)
+    assert isinstance(finals, rx_packed.PackedReceiverState)
+    view = rx_mod.receiver_final_view(
+        jax.tree_util.tree_map(lambda x: x[0], finals))
+    assert np.array_equal(view.member, np.asarray(want_final.member))
+    assert np.array_equal(view.stopped, np.asarray(want_final.stopped))
+    assert np.array_equal(view.cfg_hi, np.asarray(want_final.cfg_hi))
+    assert np.array_equal(view.cfg_lo, np.asarray(want_final.cfg_lo))
+    assert int(view.flags) == int(np.asarray(want_final.flags))
+    mlogs = jax.tree_util.tree_map(lambda x: x[0], logs)
+    _assert_tree_equal(mlogs, want_logs, "fleet logs")
+    # dense finals pass through the view shim untouched
+    assert rx_mod.receiver_final_view(want_final) is want_final
+
+
+# ---------------------------------------------------------------------------
+# budget gate: actual-pytree sizing, structured error, measured pin
+# ---------------------------------------------------------------------------
+
+
+def test_budget_gate_packed_attrs():
+    tight = PACKED.with_(receiver_capacity_cap=8)
+    with pytest.raises(fleet_mod.ReceiverBudgetError) as exc:
+        fleet_mod.check_receiver_budget(16, 4, tight)
+    err = exc.value
+    assert err.packed_bytes == rx_packed.bundle_state_bytes(16, tight)
+    assert err.unpacked_bytes == rx_mod.receiver_state_bytes(
+        16, tight.K, ring_depth=tight.delivery_ring_depth)
+    assert err.member_bytes == err.packed_bytes
+    assert err.packed_bytes < err.unpacked_bytes
+    assert "packed layout" in str(err)
+    assert fleet_mod.check_receiver_budget(8, 4, tight) == \
+        rx_packed.bundle_state_bytes(8, tight)
+    # dense mode still reports dense bytes but names the diet headroom
+    with pytest.raises(fleet_mod.ReceiverBudgetError) as exc:
+        fleet_mod.check_receiver_budget(
+            16, 4, SETTINGS.with_(receiver_capacity_cap=8))
+    err = exc.value
+    assert err.member_bytes == err.unpacked_bytes
+    assert err.packed_bytes is not None
+    assert err.packed_bytes < err.unpacked_bytes
+
+
+def test_budget_matches_measured_argument_bytes():
+    """Satellite (b): the analytic member figure the budget gate uses
+    must match XLA's measured argument bytes (minus the faults operand)
+    within 1%, for both layouts, from ``profile.receiver_memory_block``."""
+    from rapid_tpu.telemetry.profile import receiver_memory_block
+
+    blk = receiver_memory_block(SETTINGS, n=16, fleet_sizes=(1,))
+    c = blk["capacity"]
+    weights = ScenarioWeights(crash=0.0, partition=1.0, flip_flop=0.0,
+                              contested=0.0, churn=0.0)
+    sc = sample_adversary_schedule(16, 0, 8 * SETTINGS.fd_interval_ticks,
+                                   weights)
+    member = fleet_mod.lower_receiver_schedule(sc.schedule, SETTINGS,
+                                               fleet_size=1)
+    fleet = fleet_mod.stack_receiver_members([member])
+    faults_bytes = rx_packed._tree_bytes(
+        jax.eval_shape(lambda t: t, fleet.faults))
+    for entry, analytic in (
+            (blk["fleets"][0],
+             fleet_mod.check_receiver_budget(c, 1, SETTINGS)),
+            (blk["packed_fleets"][0],
+             fleet_mod.check_receiver_budget(c, 1, PACKED))):
+        measured = entry["argument_bytes"] - faults_bytes
+        assert abs(measured - analytic) <= 0.01 * analytic, \
+            f"measured {measured} vs analytic {analytic}"
+    assert blk["member_state_bytes_packed"] < blk["member_state_bytes"]
+    curve = {row["capacity"]: row for row in blk["bytes_per_member_curve"]}
+    assert curve[1024]["dense_bytes"] == rx_packed.dense_state_bytes(
+        1024, SETTINGS)

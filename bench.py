@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Repo-root benchmark shim: steady + churn + contested + partition
-+ delay + fleet suite, JSON out.
++ delay + streaming + fleet suite, JSON out.
 
 This is the harness entry point (``python bench.py``): it runs the
-engine tick benchmark six times — an N=256 steady crash-burst, an
+engine tick benchmark seven times — an N=256 steady crash-burst, an
 N=256 sustained-churn run, an N=256 contested-consensus run through
 the classic-Paxos fallback kernel, a small one-way-partition run
 through the fault adversary (a host-side oracle differential, so it
@@ -11,7 +11,11 @@ uses its own ``--partition-n`` size), a latency-adversary ``delay``
 campaign (every member draws from the delay/jitter/slow-asym family,
 runs device-exact through the per-receiver delivery ring, and the
 payload's ``campaign.delay_regimes`` block carries per-regime
-ticks-to-first-decide tails), and a deterministic Monte-Carlo
+ticks-to-first-decide tails), a ``streaming`` resident-service run
+(open-loop Poisson/burst/diurnal traffic lowered chunk-by-chunk into
+the donated ``stream_chunk_ticks`` scan, with one mid-run checkpoint
+save/restore round trip whose bit-exactness verdicts the payload
+carries; see ``rapid_tpu/service/``), and a deterministic Monte-Carlo
 ``fleet`` campaign (``--fleet-clusters`` N=``--fleet-n`` clusters with
 a mixed fault/churn sample, vmapped ``--fleet-size`` clusters per
 dispatch so the committed payload carries a multi-dispatch timeline;
@@ -68,13 +72,14 @@ from benchmarks.bench_engine import (  # noqa: E402
     run_delay,
     run_fleet,
     run_partition,
+    run_streaming,
 )
 
 
 #: Suite entries in run order (heaviest last, so a budget cut keeps the
 #: cheap protocol entries).
 SUITE_ENTRIES = ("steady", "churn", "contested", "partition", "delay",
-                 "fleet")
+                 "streaming", "fleet")
 
 #: ``--fast`` preset: every knob shrunk to smoke scale. Applied only to
 #: knobs the caller left at their defaults, so ``--fast --n 512`` still
@@ -82,6 +87,8 @@ SUITE_ENTRIES = ("steady", "churn", "contested", "partition", "delay",
 FAST_PRESET = {
     "n": 128, "ticks": 96, "partition_n": 32, "partition_ticks": 200,
     "delay_clusters": 4, "delay_n": 32, "delay_ticks": 160,
+    "streaming_n": 16, "streaming_capacity": 48,
+    "streaming_ticks": 1024, "streaming_chunk": 128,
     "fleet_clusters": 16, "fleet_size": 8, "fleet_n": 32,
     "fleet_ticks": 96,
 }
@@ -151,6 +158,19 @@ def main(argv=None) -> int:
                         help="ticks per delay-campaign cluster (covers "
                              "FD saturation plus a delayed view change; "
                              "default 240)")
+    parser.add_argument("--streaming-n", type=int, default=24,
+                        help="initial members for the streaming entry "
+                             "(default 24)")
+    parser.add_argument("--streaming-capacity", type=int, default=96,
+                        help="slot universe for the streaming entry "
+                             "(members + joiner pool; default 96)")
+    parser.add_argument("--streaming-ticks", type=int, default=3072,
+                        help="total streamed ticks (chunked; covers "
+                             "several traffic bursts plus the mid-run "
+                             "checkpoint round trip; default 3072)")
+    parser.add_argument("--streaming-chunk", type=int, default=256,
+                        help="Settings.stream_chunk_ticks for the "
+                             "streaming entry (default 256)")
     parser.add_argument("--fleet-clusters", type=int, default=128,
                         help="clusters in the fleet campaign entry "
                              "(default 128: two shared dispatches of "
@@ -198,6 +218,11 @@ def main(argv=None) -> int:
         "delay": lambda: run_delay(args.delay_clusters, args.delay_n,
                                    args.delay_ticks, settings, args.seed,
                                    fleet_size=args.delay_clusters),
+        "streaming": lambda: run_streaming(args.streaming_n,
+                                           args.streaming_capacity,
+                                           args.streaming_ticks,
+                                           args.streaming_chunk,
+                                           settings, args.seed),
         "fleet": lambda: run_fleet(args.fleet_clusters, args.fleet_n,
                                    args.fleet_ticks, settings, args.seed,
                                    fleet_size=args.fleet_size),

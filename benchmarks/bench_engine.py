@@ -365,6 +365,78 @@ def run_delay(clusters: int, n: int, ticks: int, settings, seed: int = 0,
     return payload
 
 
+def run_streaming(n: int, capacity: int, ticks: int, chunk_ticks: int,
+                  settings, seed: int = 0) -> dict:
+    """Streaming service entry: the resident engine under open-loop
+    traffic (Poisson joins, correlated leave bursts, a diurnal wave),
+    run as donated double-buffered ``stream_chunk_ticks`` scan segments
+    with one mid-run checkpoint save/restore round trip
+    (``ResidentEngine.verify_round_trip`` — the payload's ``checkpoint``
+    block carries the bit-exactness verdicts, and the baseline gates
+    them exactly). Event counts, protocol totals, the decide-latency
+    tail, and the traffic config are deterministic in ``seed``; the
+    events/sec figure is the wall-clock rate the stream sustained."""
+    import dataclasses
+    import tempfile
+
+    import jax
+
+    from rapid_tpu.campaign import _rate
+    from rapid_tpu.service import TrafficConfig, boot_resident
+    from rapid_tpu.telemetry.metrics import summarize
+
+    settings = dataclasses.replace(settings,
+                                   stream_chunk_ticks=chunk_ticks)
+    traffic = TrafficConfig(seed=seed, diurnal_amplitude=0.3,
+                            diurnal_period_ticks=max(256, ticks // 4))
+    n_chunks = max(2, -(-ticks // chunk_ticks))
+    eng = boot_resident(settings, capacity, n, seed=seed,
+                        traffic_config=traffic)
+    run_start = time.perf_counter()
+    first = n_chunks // 2
+    eng.run(first)
+    with tempfile.TemporaryDirectory(prefix="rapid_stream_ck_") as ckdir:
+        eng.verify_round_trip(os.path.join(ckdir, "ck"))
+    eng.run(n_chunks - first - 1)
+    wall_s = time.perf_counter() - run_start
+    summary = eng.summary()
+    eng.close()
+
+    telemetry = summarize(eng.metrics).as_dict()
+    ticks_per_sec = summary["ticks"] / wall_s
+    return {
+        "bench": "engine_tick",
+        "schema_version": _schema_version(),
+        "scenario": "streaming",
+        "platform": jax.default_backend(),
+        "n": n,
+        "capacity": capacity,
+        "k": settings.K,
+        "ticks": summary["ticks"],
+        "chunk_ticks": chunk_ticks,
+        "chunks": summary["chunks"],
+        "events_injected": summary["events_injected"],
+        "joins": summary["joins"],
+        "leaves": summary["leaves"],
+        "bursts": summary["bursts"],
+        "wall_s": round(wall_s, 4),
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "rounds_per_sec": round(
+            ticks_per_sec / settings.fd_interval_ticks, 2),
+        "events_per_sec": _rate(summary["events_injected"], wall_s),
+        "announcements": telemetry["announcements"],
+        "decisions": telemetry["decisions"],
+        "final_members": int(np.asarray(eng.state.member).sum()),
+        "ticks_to_first_decide": telemetry["ticks_to_first_decide"],
+        "messages_per_view_change": telemetry["messages_per_view_change"],
+        "ticks_to_view_change": summary["ticks_to_view_change"],
+        "traffic": summary["traffic"],
+        "checkpoint": summary["checkpoint"],
+        "live_buffer_bytes": summary["live_buffer_bytes"],
+        "telemetry": telemetry,
+    }
+
+
 def run_fleet(clusters: int, n: int, ticks: int, settings, seed: int = 0,
               fleet_size: int = None, spot_checks: int = 0) -> dict:
     """Monte-Carlo fleet campaign: ``clusters`` sampled fault/churn
@@ -395,7 +467,8 @@ def main(argv=None) -> int:
                         help="tick of the correlated crash burst")
     parser.add_argument("--scenario",
                         choices=("steady", "churn", "contested",
-                                 "partition", "delay", "fleet"),
+                                 "partition", "delay", "streaming",
+                                 "fleet"),
                         default="steady",
                         help="steady crash-burst, sustained join/leave "
                              "churn, contested consensus through the "
@@ -405,9 +478,11 @@ def main(argv=None) -> int:
                              "and --ticks >= 250), a latency-adversary "
                              "campaign over the delay/jitter/slow-asym "
                              "family (per-receiver delivery ring, "
-                             "per-regime decide tails), or a vmapped "
-                             "Monte-Carlo fleet campaign over sampled "
-                             "scenarios (default steady)")
+                             "per-regime decide tails), a resident "
+                             "streaming run under open-loop traffic "
+                             "with a mid-run checkpoint round trip, or "
+                             "a vmapped Monte-Carlo fleet campaign over "
+                             "sampled scenarios (default steady)")
     parser.add_argument("--clusters", type=int, default=64,
                         help="fleet scenario: sampled clusters")
     parser.add_argument("--fleet-size", type=int, default=None,
@@ -418,6 +493,12 @@ def main(argv=None) -> int:
                              "the host oracle referee")
     parser.add_argument("--burst", type=int, default=8,
                         help="churn scenario: slots per join/leave burst")
+    parser.add_argument("--capacity", type=int, default=None,
+                        help="streaming scenario: slot universe "
+                             "(default 4 * n)")
+    parser.add_argument("--chunk", type=int, default=256,
+                        help="streaming scenario: "
+                             "Settings.stream_chunk_ticks")
     parser.add_argument("--seed", type=int, default=0,
                         help="perturbs the synthetic node identities")
     parser.add_argument("--out", type=str, default=None,
@@ -491,6 +572,14 @@ def main(argv=None) -> int:
             results = [run_delay(args.clusters, n, args.ticks, settings,
                                  args.seed, fleet_size=args.fleet_size,
                                  spot_checks=args.spot_checks)
+                       for n in sizes]
+        elif args.scenario == "streaming":
+            if writer is not None:
+                parser.error("--trace records one jitted run; the "
+                             "streaming scenario is a chunked stream")
+            results = [run_streaming(n, args.capacity or 4 * n,
+                                     args.ticks, args.chunk, settings,
+                                     args.seed)
                        for n in sizes]
         elif args.scenario == "fleet":
             if writer is not None:

@@ -989,6 +989,12 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             "memory": memory,
         }
         timeline.append(rec)
+        # Schema v9: heartbeat throughput — virtual ticks retired and
+        # protocol events (announces + decides) observed across this
+        # dispatch's members, over the dispatch wall. Same null-below-
+        # the-floor convention as every other rate.
+        events = sum(s.announcements + s.decisions
+                     for s in summaries[-len(chunk):])
         progress.emit({"record": "dispatch", "index": rec["index"],
                        "mode": mode, "pool_id": pid,
                        "pool_shape": rec["pool_shape"],
@@ -996,7 +1002,9 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                        "clusters_done": done,
                        "clusters_total": total, "stages": rec["stages"],
                        "spot_failures": spot["failed"],
-                       "anomalies": dict(anomalies)})
+                       "anomalies": dict(anomalies),
+                       "ticks_per_sec": _rate(len(chunk) * cfg.ticks, wall),
+                       "events_per_sec": _rate(events, wall)})
         return rec
 
     # The driver: launch each planned dispatch, retiring the oldest

@@ -44,6 +44,7 @@ from rapid_tpu.engine.step import (
     engine_step,
     reset_trace_count,
     simulate,
+    simulate_chunk,
     step,
     trace_count,
 )
@@ -89,6 +90,7 @@ __all__ = [
     "ring_permutations",
     "shard_put",
     "simulate",
+    "simulate_chunk",
     "slot_mesh",
     "spec_for",
     "stack_members",

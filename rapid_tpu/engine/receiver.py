@@ -1017,6 +1017,58 @@ def receiver_simulate(rs: ReceiverState, faults: EngineFaults,
     return _simulate(rs, faults, n_ticks, settings)
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _simulate_resumed(rs, rec, faults, n_ticks: int, settings: Settings):
+    """``_simulate`` with the flight recorder carried in — the chunked
+    continuation entry (``receiver_simulate_chunk``, chunks 2+)."""
+    def rec_body(carry, _):
+        st, r = carry
+        nxt, log = receiver_step(st, faults, settings)
+        return (nxt, recorder_mod.record_receiver_step(
+            r, log, settings)), log
+
+    (final, rec), logs = lax.scan(rec_body, (rs, rec), None,
+                                  length=n_ticks)
+    return final, logs, rec
+
+
+# Donated twins for the resident service: the dense carry (and recorder)
+# buffers are reused for the chunk's outputs, so a soak holds one
+# state-sized working set. Faults stay undonated — the same pytree feeds
+# every chunk.
+_simulate_donated = functools.partial(
+    jax.jit, static_argnums=(2, 3), donate_argnums=(0,))(
+        _simulate.__wrapped__)
+_simulate_resumed_donated = functools.partial(
+    jax.jit, static_argnums=(3, 4), donate_argnums=(0, 1))(
+        _simulate_resumed.__wrapped__)
+
+
+def receiver_simulate_chunk(carry, faults, n_ticks: int, settings: Settings,
+                            rec=None, donate: bool = True):
+    """One streaming chunk of the per-receiver scan, layout-preserving.
+
+    Under ``rx_kernel="xla"`` the carry is a dense ``ReceiverState`` and
+    the final comes back dense; under the packed layouts the carry is a
+    ``rx_packed.PackedReceiverBundle`` (boot one via
+    ``rx_packed.as_bundle``) and the final comes back as a bundle — the
+    carry type round-trips, so the service re-feeds it verbatim. ``rec``
+    resumes the flight recorder (required for chunks after the first when
+    ``settings.flight_recorder_window > 0``); ``donate`` hands the carry
+    buffers to the executable. Chained chunks are bit-identical to one
+    uninterrupted ``receiver_simulate`` of the summed length."""
+    if settings.rx_kernel != "xla":
+        from rapid_tpu.engine import rx_packed
+        return rx_packed.simulate_chunk(carry, faults, n_ticks, settings,
+                                        rec=rec, donate=donate)
+    n_ticks = int(n_ticks)
+    if settings.flight_recorder_window and rec is not None:
+        fn = _simulate_resumed_donated if donate else _simulate_resumed
+        return fn(carry, rec, faults, n_ticks, settings)
+    fn = _simulate_donated if donate else _simulate
+    return fn(carry, faults, n_ticks, settings)
+
+
 def receiver_final_view(final):
     """Dense view of the final-state fields host extraction reads
     (member, stopped, cfg limbs, flags): the identity on dense finals,

@@ -301,6 +301,89 @@ def simulate(state, faults, n_ticks: int, settings: Settings):
                             int(n_ticks), settings, True)
 
 
+# --- streaming chunks ----------------------------------------------------
+#
+# The resident service re-enters the packed scan chunk by chunk. The
+# delay table is split out of the jit signature so the packed carry (and
+# resumed recorder) can be donated without consuming the table — it is a
+# scan constant reused by every chunk, and with ``dense_final=False``
+# semantics the final stays packed so the carry type round-trips.
+
+def _chunk_body(packed, delay_table, faults, n_ticks: int,
+                settings: Settings):
+    def step(ps, _):
+        rs = unpack_receiver_state(ps, delay_table, settings)
+        nxt, log = receiver_mod.receiver_step(rs, faults, settings)
+        return pack_receiver_state(nxt, settings), log
+
+    if settings.flight_recorder_window:
+        def rec_body(carry, _):
+            st, rec = carry
+            nxt, log = step(st, None)
+            return (nxt, recorder_mod.record_receiver_step(
+                rec, log, settings)), log
+
+        (final, rec), logs = lax.scan(
+            rec_body, (packed, recorder_mod.init(settings)), None,
+            length=n_ticks)
+        return final, logs, rec
+
+    final, logs = lax.scan(step, packed, None, length=n_ticks)
+    return final, logs
+
+
+def _chunk_resumed_body(packed, rec, delay_table, faults, n_ticks: int,
+                        settings: Settings):
+    def rec_body(carry, _):
+        ps, r = carry
+        rs = unpack_receiver_state(ps, delay_table, settings)
+        nxt, log = receiver_mod.receiver_step(rs, faults, settings)
+        return (pack_receiver_state(nxt, settings),
+                recorder_mod.record_receiver_step(r, log, settings)), log
+
+    (final, rec), logs = lax.scan(rec_body, (packed, rec), None,
+                                  length=n_ticks)
+    return final, logs, rec
+
+
+_chunk_jit = functools.partial(
+    jax.jit, static_argnums=(3, 4))(_chunk_body)
+_chunk_donated = functools.partial(
+    jax.jit, static_argnums=(3, 4), donate_argnums=(0,))(_chunk_body)
+_chunk_resumed_jit = functools.partial(
+    jax.jit, static_argnums=(4, 5))(_chunk_resumed_body)
+_chunk_resumed_donated = functools.partial(
+    jax.jit, static_argnums=(4, 5), donate_argnums=(0, 1))(
+        _chunk_resumed_body)
+
+
+def simulate_chunk(bundle, faults, n_ticks: int, settings: Settings,
+                   rec=None, donate: bool = True):
+    """One streaming chunk over the packed carry: bundle in, bundle out.
+
+    Returns ``(PackedReceiverBundle, logs)`` — or ``(..., logs, rec)``
+    when the recorder window is nonzero, resuming from ``rec`` when
+    given. Chained chunks are bit-identical to one uninterrupted
+    :func:`simulate` of the summed length (same unpack/step/repack body,
+    same carry)."""
+    bundle = as_bundle(bundle, settings)
+    n_ticks = int(n_ticks)
+    dt = bundle.delay_table
+    if settings.flight_recorder_window and rec is not None:
+        fn = _chunk_resumed_donated if donate else _chunk_resumed_jit
+        final, logs, rec = fn(bundle.packed, rec, dt, faults, n_ticks,
+                              settings)
+        return PackedReceiverBundle(packed=final, delay_table=dt), logs, rec
+    fn = _chunk_donated if donate else _chunk_jit
+    out = fn(bundle.packed, dt, faults, n_ticks, settings)
+    if settings.flight_recorder_window:
+        final, logs, rec = out
+        return (PackedReceiverBundle(packed=final, delay_table=dt), logs,
+                rec)
+    final, logs = out
+    return PackedReceiverBundle(packed=final, delay_table=dt), logs
+
+
 def fleet_body(bundle, faults, n_ticks: int, settings: Settings,
                fleet_mesh=None):
     """The packed twin of ``receiver._fleet_body`` — finals stay *packed*

@@ -468,6 +468,80 @@ def simulate(state: EngineState, faults: EngineFaults, n_ticks: int,
 
 
 # ---------------------------------------------------------------------------
+# streaming chunks: re-enter the scan with the previous chunk's carry
+# ---------------------------------------------------------------------------
+#
+# The resident service (``rapid_tpu.service.resident``) runs an unbounded
+# stream as fixed-size scan segments: every chunk re-enters the same jitted
+# executable with the previous chunk's final state as its initial carry, so
+# one compile serves the whole stream and the host drains chunk k-1's logs
+# while the device computes chunk k. Two wrinkles vs ``_simulate``:
+#
+# - the flight recorder must *resume*, not restart — ``_simulate`` always
+#   scans from ``recorder.init``, so chunks 2+ go through
+#   ``_simulate_resumed`` which takes the ring as an explicit input carry;
+# - the state (and recorder) buffers are donated so XLA reuses them for
+#   the outputs — a soak keeps one state-sized working set alive instead
+#   of accreting input+output per chunk. Faults/churn/fallback are NOT
+#   donated: the fault pytree is reused across every chunk and the churn
+#   schedule is still referenced by the traffic generator after dispatch.
+
+@partial(jax.jit, static_argnums=(3, 4, 7))
+def _simulate_resumed(state, rec, faults, n_ticks: int, settings: Settings,
+                      churn=None, fallback=None, mesh=None):
+    """``_simulate`` with the recorder carried in (chunks 2+, W > 0)."""
+    if mesh is not None:
+        c = state.member.shape[0]
+        state = sharding_mod.constrain_tree(state, mesh, c)
+        faults = sharding_mod.constrain_tree(faults, mesh, c)
+
+    def rec_body(carry, _):
+        st, r = carry
+        nxt, log = step(st, faults, settings, churn, fallback, mesh)
+        return (nxt, recorder_mod.record_step(r, log, settings)), log
+
+    (final, rec), logs = lax.scan(rec_body, (state, rec), None,
+                                  length=n_ticks)
+    return final, logs, rec
+
+
+_simulate_donated = partial(
+    jax.jit, static_argnums=(2, 3, 6), donate_argnums=(0,))(
+        lambda state, faults, n_ticks, settings, churn=None, fallback=None,
+        mesh=None: _simulate.__wrapped__(state, faults, n_ticks, settings,
+                                         churn, fallback, mesh))
+
+_simulate_resumed_donated = partial(
+    jax.jit, static_argnums=(3, 4, 7), donate_argnums=(0, 1))(
+        lambda state, rec, faults, n_ticks, settings, churn=None,
+        fallback=None, mesh=None: _simulate_resumed.__wrapped__(
+            state, rec, faults, n_ticks, settings, churn, fallback, mesh))
+
+
+def simulate_chunk(state: EngineState, faults: EngineFaults, n_ticks: int,
+                   settings: Settings, churn=None, fallback=None, mesh=None,
+                   rec=None, donate: bool = True) -> tuple:
+    """One streaming chunk: ``n_ticks`` steps from an arbitrary carry.
+
+    Identical semantics to ``simulate`` except the flight recorder
+    resumes from ``rec`` when given (required for chunks after the first
+    whenever ``settings.flight_recorder_window > 0``), and ``donate=True``
+    (the default) donates the state (and recorder) buffers to the
+    executable. Returns ``(final, logs)`` — or ``(final, logs, rec)``
+    when the recorder window is nonzero. Chaining
+    ``simulate_chunk(...); simulate_chunk(final, ..., rec=rec)`` is
+    bit-identical to one uninterrupted ``simulate`` of the summed length
+    (proven in ``tests/test_service.py``)."""
+    n_ticks = int(n_ticks)
+    if settings.flight_recorder_window and rec is not None:
+        fn = _simulate_resumed_donated if donate else _simulate_resumed
+        return fn(state, rec, faults, n_ticks, settings, churn, fallback,
+                  mesh)
+    fn = _simulate_donated if donate else _simulate
+    return fn(state, faults, n_ticks, settings, churn, fallback, mesh)
+
+
+# ---------------------------------------------------------------------------
 # fleet axis: vmap the scanned step over a leading batch of clusters
 # ---------------------------------------------------------------------------
 

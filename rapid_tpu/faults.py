@@ -868,6 +868,78 @@ def sample_adversary_schedule(
                            wants_churn=wants_churn)
 
 
+def two_zone_schedule(n: int, seed: int, ticks: int,
+                      ring_depth: int = 4,
+                      fd_interval: int = 10) -> AdversarySchedule:
+    """The named two-zone deployment scenario as a concrete schedule.
+
+    Splits the universe into ``zone_a = [0, n//2)`` and
+    ``zone_b = [n//2, n)`` — two racks behind one congested uplink:
+
+    - intra-zone traffic is *fast* (no rule: one-hop baseline both ways);
+    - cross-zone traffic gets one slow-asym ``DelayRule`` — the a->b
+      direction carries the congested base, the return path a strictly
+      smaller one, both directions sharing a small jitter bound so
+      cross-zone messages also reorder;
+    - one correlated crash burst inside ``zone_b`` (a quarter of the
+      zone, same tick) — the rack-level analogue of the traffic
+      generator's correlated leave bursts, forcing view changes whose
+      evidence must cross the slow uplink.
+
+    Knob draws come from ``random.Random(seed)`` so campaigns get a
+    family of two-zone instances, but the zone split itself is fixed.
+    The schedule is validated against ``ring_depth`` before it is
+    returned — a delivery ring too shallow for the drawn worst case
+    raises ``DelayBudgetError`` up front, which is how callers size
+    ``Settings.delivery_ring_depth`` for this preset.
+    """
+    import random as _random
+
+    if n < 4:
+        raise ValueError(f"two_zone needs n >= 4 (got {n})")
+    rng = _random.Random(seed)
+    zone_a = frozenset(range(n // 2))
+    zone_b = frozenset(range(n // 2, n))
+    jitter = 1 if ring_depth >= 3 else 0
+    fwd = rng.randint(2, max(2, ring_depth - 1 - jitter))
+    rev = rng.randint(1, fwd - 1) if fwd > 1 else 0
+    delays = (DelayRule(src_slots=zone_a, dst_slots=zone_b,
+                        delay_ticks=fwd, jitter_ticks=jitter,
+                        reverse_delay_ticks=rev, start_tick=0),)
+    burst_tick = rng.randint(1, max(1, min(fd_interval, ticks - 1)))
+    n_crash = max(1, len(zone_b) // 4)
+    crashes = tuple(sorted(
+        (slot, burst_tick)
+        for slot in rng.sample(sorted(zone_b), n_crash)))
+    schedule = AdversarySchedule(n=n, crashes=crashes, seed=seed,
+                                 delays=delays)
+    validate_schedule(schedule, ring_depth=ring_depth)
+    return schedule
+
+
+#: Named scenario mixes for campaigns. ``"two_zone"`` biases the sampler
+#: toward the slow-asym latency regime with crash pressure — the weights
+#: twin of the concrete ``two_zone_schedule`` instance family (which
+#: differential tests validate directly at N=64).
+SCENARIO_WEIGHT_PRESETS: Dict[str, ScenarioWeights] = {
+    "default": DEFAULT_SCENARIO_WEIGHTS,
+    "two_zone": ScenarioWeights(
+        crash=1.0, partition=0.0, flip_flop=0.0, contested=0.0,
+        churn=0.0, delay=0.0, jitter=0.0, slow_asym=3.0),
+}
+
+
+def scenario_weights_preset(name: str) -> ScenarioWeights:
+    """Look up a named ``ScenarioWeights`` preset; raises with the
+    catalogue on an unknown name."""
+    try:
+        return SCENARIO_WEIGHT_PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario-weights preset {name!r}; known presets: "
+            f"{sorted(SCENARIO_WEIGHT_PRESETS)}") from None
+
+
 # ---------------------------------------------------------------------------
 # Deterministic Bernoulli sampling shared host/device
 # ---------------------------------------------------------------------------

@@ -1,0 +1,48 @@
+"""Streaming service mode: the engine as a long-lived membership process.
+
+Three pieces (see ``ROADMAP.md`` "Streaming service mode"):
+
+- ``resident`` — the chunked, donated, double-buffered driver around
+  ``engine.step.simulate_chunk``, streaming ``TickMetrics`` JSONL;
+- ``checkpoint`` — versioned save/restore of every scan carry family
+  (engine, dense receiver, packed receiver, recorder ring), proven
+  bit-identical across the save/load boundary;
+- ``traffic`` — the seeded open-loop arrival processes (Poisson joins,
+  correlated leave bursts, diurnal waves) lowered chunk-by-chunk onto
+  ``ChurnSchedule``.
+
+``python -m rapid_tpu.service --soak`` runs the long-haul gate: >=100k
+ticks in chunks at constant memory with one mid-soak save/restore
+round-trip proven bit-identical.
+"""
+from rapid_tpu.service.checkpoint import (
+    CHECKPOINT_VERSION,
+    Checkpoint,
+    CheckpointCompatError,
+    CheckpointError,
+    CheckpointVersionError,
+    load_checkpoint,
+    restore_receiver_carry,
+    save_checkpoint,
+    save_engine,
+    save_receiver,
+)
+from rapid_tpu.service.resident import ResidentEngine, boot_resident
+from rapid_tpu.service.traffic import TrafficConfig, TrafficGenerator
+
+__all__ = [
+    "CHECKPOINT_VERSION",
+    "Checkpoint",
+    "CheckpointCompatError",
+    "CheckpointError",
+    "CheckpointVersionError",
+    "ResidentEngine",
+    "TrafficConfig",
+    "TrafficGenerator",
+    "boot_resident",
+    "load_checkpoint",
+    "restore_receiver_carry",
+    "save_checkpoint",
+    "save_engine",
+    "save_receiver",
+]

@@ -1,0 +1,389 @@
+"""Resident engine: the tick loop as a long-lived, chunked service.
+
+Everything else in the repo is "boot, scan T ticks, exit"; Rapid itself
+(``Cluster.Builder``) is a resident process serving live join/leave
+traffic. This driver closes that gap:
+
+- the stream runs as fixed-size ``lax.scan`` segments
+  (``Settings.stream_chunk_ticks``, static) — every chunk re-enters the
+  same compiled executable with the previous chunk's final carry
+  (``engine.step.simulate_chunk``), so an unbounded run pays one
+  compile;
+- dispatch is **double-buffered**: chunk ``k`` is launched (JAX async
+  dispatch) *before* chunk ``k-1``'s logs are pulled to the host, so
+  metrics normalization, JSONL writes and traffic generation overlap
+  device compute instead of serializing with it;
+- carries are **donated** — XLA reuses the state (and recorder ring)
+  buffers for the chunk's outputs, so the device working set stays flat
+  at steady state (the soak artifact commits the live-buffer watermark
+  per chunk to prove it);
+- an attached :class:`~rapid_tpu.service.traffic.TrafficGenerator`
+  lowers its next window of arrivals into each chunk's
+  ``ChurnSchedule`` (quiet windows reuse one inert all-``I32_MAX``
+  schedule so the executable signature never changes);
+- :meth:`ResidentEngine.save` / :meth:`ResidentEngine.restore` move the
+  whole service through ``service.checkpoint`` — engine state, recorder
+  ring mid-fill, and the traffic generator's rng snapshot in the
+  ``host`` blob — and :meth:`verify_round_trip` *proves* a restore is
+  exact: restored pytrees bitwise-equal the live ones, and one
+  continuation chunk run from both produces byte-identical ``StepLog``
+  columns and recorder rings.
+
+The metrics stream is JSONL (``telemetry.write`` conventions): one
+``TickMetrics`` row per tick (optional), one ``record: "chunk"``
+heartbeat per chunk, one final ``record: "stream_summary"`` line —
+validated by ``telemetry.schema.validate_streaming_stream``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from rapid_tpu.engine import churn as churn_mod
+from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
+                                    crash_faults, init_state)
+from rapid_tpu.engine.step import simulate_chunk
+from rapid_tpu.service import checkpoint as checkpoint_mod
+from rapid_tpu.service.traffic import TrafficConfig, TrafficGenerator
+from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry import engine_metrics, json_artifact_line, summarize
+from rapid_tpu.telemetry.metrics import _dist
+
+# One rate convention across campaign heartbeats and the service stream:
+# a wall below the floor reports null instead of a garbage rate.
+from rapid_tpu.campaign import MIN_MEASURABLE_WALL_S, _rate  # noqa: F401
+
+
+def _tree_equal(a, b) -> bool:
+    """Bitwise pytree equality on the host."""
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _dealias(tree):
+    """Copy every leaf onto its own buffer. ``init_state`` shares one
+    zeros buffer across several fields; donating such a carry would hand
+    the same buffer to XLA twice."""
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), tree)
+
+
+def _live_buffer_bytes() -> int:
+    return int(sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.live_arrays()))
+
+
+def synthetic_uids(n: int, seed: int = 0) -> np.ndarray:
+    """Distinct 64-bit node identities (same stream as the benches)."""
+    from rapid_tpu import hashing
+
+    hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF ^ (seed & 0xFFFF))
+    return hashing.np_from_limbs(hi, lo)
+
+
+class ResidentEngine:
+    """One resident shared-state engine plus its I/O loop.
+
+    ``sink`` (a path or None) receives the JSONL metrics stream;
+    ``write_ticks=False`` keeps only chunk heartbeats and the summary
+    (100k-tick soaks at small N don't need 100k rows committed).
+    """
+
+    def __init__(self, state: EngineState, faults: EngineFaults,
+                 settings: Settings, *,
+                 traffic: Optional[TrafficGenerator] = None,
+                 sink: Optional[str] = None, write_ticks: bool = True,
+                 donate: bool = True, n_initial: Optional[int] = None):
+        self.settings = settings
+        self.capacity = int(state.member.shape[0])
+        self.n_initial = (int(np.asarray(state.member).sum())
+                          if n_initial is None else int(n_initial))
+        self._state = _dealias(state)
+        self._rec = None
+        self._faults = faults
+        self.traffic = traffic
+        self._inert_schedule = (churn_mod.empty_schedule(self.capacity)
+                                if traffic is not None else None)
+        self._donate = donate
+        self._sink = open(sink, "w") if sink else None
+        self._write_ticks = write_ticks
+        self._pending = None
+        self.metrics: list = []
+        self.chunk_records: list = []
+        self.chunks = 0
+        self.ticks = 0
+        self.checkpoint_block: Optional[dict] = None
+        self._wall0 = time.perf_counter()
+        self._last_drain_wall = self._wall0
+        self._watermarks: list = []
+
+    @property
+    def state(self) -> EngineState:
+        """The current carry (chunk-boundary accurate after ``flush``)."""
+        return self._state
+
+    # --- internals --------------------------------------------------------
+
+    def _next_schedule(self):
+        if self.traffic is None:
+            return None, None
+        schedule, tinfo = self.traffic.next_chunk(
+            self.settings.stream_chunk_ticks)
+        # Quiet windows reuse one inert schedule: same pytree structure,
+        # same shapes -> same executable as a busy chunk.
+        return (self._inert_schedule if schedule is None else schedule,
+                tinfo)
+
+    def _emit(self, record: dict) -> None:
+        if self._sink is not None:
+            self._sink.write(json_artifact_line(record, sort_keys=True))
+            self._sink.flush()
+
+    def _dispatch(self, *, donate: Optional[bool] = None) -> dict:
+        schedule, tinfo = self._next_schedule()
+        out = simulate_chunk(
+            self._state, self._faults, self.settings.stream_chunk_ticks,
+            self.settings, churn=schedule, rec=self._rec,
+            donate=self._donate if donate is None else donate)
+        if self.settings.flight_recorder_window:
+            self._state, logs, self._rec = out
+        else:
+            self._state, logs = out
+        pending = {"index": self.chunks, "logs": logs, "tinfo": tinfo,
+                   "checkpoint": None}
+        self.chunks += 1
+        self.ticks += self.settings.stream_chunk_ticks
+        return pending
+
+    def _drain(self, pending: dict) -> None:
+        logs = pending["logs"]
+        jax.block_until_ready(logs)
+        rows = engine_metrics(logs)
+        self.metrics.extend(rows)
+        if self._write_ticks:
+            for row in rows:
+                self._emit(row.as_dict())
+        now = time.perf_counter()
+        wall = now - self._last_drain_wall
+        self._last_drain_wall = now
+        live = _live_buffer_bytes()
+        self._watermarks.append(live)
+        tinfo = pending["tinfo"]
+        record = {
+            "record": "chunk",
+            "index": pending["index"],
+            "tick": rows[-1].tick if rows else self.ticks,
+            "ticks": self.settings.stream_chunk_ticks,
+            "wall_s": wall,
+            "ticks_per_sec": _rate(self.settings.stream_chunk_ticks, wall),
+            "events_per_sec": _rate(tinfo["events"], wall) if tinfo else None,
+            "announces": sum(r.announce for r in rows),
+            "decides": sum(r.decide for r in rows),
+            "live_buffer_bytes": live,
+            "traffic": tinfo,
+            "checkpoint": pending["checkpoint"],
+        }
+        self.chunk_records.append(record)
+        self._emit(record)
+
+    # --- public loop ------------------------------------------------------
+
+    def flush(self) -> None:
+        """Drain the in-flight chunk, if any."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            self._drain(pending)
+
+    def run(self, n_chunks: int) -> None:
+        """Run ``n_chunks`` chunks, double-buffered: chunk ``k`` is
+        dispatched before chunk ``k-1``'s host I/O runs."""
+        for _ in range(int(n_chunks)):
+            dispatched = self._dispatch()
+            self.flush()
+            self._pending = dispatched
+        self.flush()
+
+    # --- checkpoint/restore ----------------------------------------------
+
+    def _host_blob(self) -> dict:
+        blob = {"chunks": self.chunks, "ticks": self.ticks,
+                "n_initial": self.n_initial}
+        if self.traffic is not None:
+            blob["traffic"] = self.traffic.state_dict()
+        return blob
+
+    def save(self, path: str) -> dict:
+        """Checkpoint the full service (engine carry, recorder ring,
+        traffic generator) — drains the in-flight chunk first so the
+        saved carry is a chunk boundary."""
+        self.flush()
+        return checkpoint_mod.save_engine(
+            path, self._state, self.settings, rec=self._rec,
+            host=self._host_blob())
+
+    @classmethod
+    def restore(cls, path: str, faults: EngineFaults, settings: Settings,
+                **kw) -> "ResidentEngine":
+        cp = checkpoint_mod.load_checkpoint(path, settings)
+        if cp.family != "engine":
+            raise checkpoint_mod.CheckpointError(
+                f"ResidentEngine.restore needs an engine checkpoint, "
+                f"got family {cp.family!r}")
+        host = cp.host or {}
+        traffic = kw.pop("traffic", None)
+        if traffic is None and "traffic" in host:
+            traffic = TrafficGenerator.from_state(host["traffic"], settings)
+        eng = cls(cp.parts["state"], faults, settings, traffic=traffic,
+                  n_initial=host.get("n_initial"), **kw)
+        rec = cp.parts.get("recorder")
+        # Own buffers before the first donated dispatch: the npz-backed
+        # host arrays must not be handed to XLA as donations.
+        eng._rec = _dealias(rec) if rec is not None else None
+        eng.chunks = int(host.get("chunks", 0))
+        eng.ticks = int(host.get("ticks", cp.tick))
+        return eng
+
+    def verify_round_trip(self, path: str) -> dict:
+        """Save, restore, and prove the restore exact; returns the
+        ``checkpoint`` block the summary embeds.
+
+        Two layers of proof: (a) every restored pytree leaf is bitwise
+        equal to its live twin; (b) one continuation chunk run from the
+        live carry and from the restored carry (same traffic window,
+        undonated so both inputs survive) produces byte-identical
+        ``StepLog`` columns, final states, and recorder rings. The
+        restored branch then *becomes* the stream — continuation after
+        restore is the run from here on, so the committed soak is itself
+        evidence that a restore loses nothing.
+        """
+        self.flush()
+        self.save(path)
+        cp = checkpoint_mod.load_checkpoint(path, self.settings)
+        r_state = cp.parts["state"]
+        r_rec = cp.parts.get("recorder")
+        state_identical = _tree_equal(self._state, r_state)
+        recorder_identical = (_tree_equal(self._rec, r_rec)
+                              if self._rec is not None else None)
+
+        schedule, tinfo = self._next_schedule()
+        n = self.settings.stream_chunk_ticks
+        live = simulate_chunk(self._state, self._faults, n, self.settings,
+                              churn=schedule, rec=self._rec, donate=False)
+        rest = simulate_chunk(r_state, self._faults, n, self.settings,
+                              churn=schedule, rec=r_rec, donate=False)
+        if self.settings.flight_recorder_window:
+            l_final, l_logs, l_rec = live
+            r_final, r_logs, r_rec2 = rest
+            cont_rec_ok = _tree_equal(l_rec, r_rec2)
+        else:
+            l_final, l_logs = live
+            r_final, r_logs = rest
+            l_rec = r_rec2 = None
+            cont_rec_ok = None
+        block = {
+            "version": checkpoint_mod.CHECKPOINT_VERSION,
+            "tick": cp.tick,
+            "state_identical": bool(state_identical),
+            "recorder_identical": recorder_identical,
+            "logs_identical": bool(_tree_equal(l_logs, r_logs)),
+            "final_identical": bool(_tree_equal(l_final, r_final)),
+            "continuation_recorder_identical": cont_rec_ok,
+        }
+        # Adopt the restored branch as the continuing carry.
+        self._state = _dealias(r_final)
+        self._rec = _dealias(r_rec2) if r_rec2 is not None else None
+        pending = {"index": self.chunks, "logs": r_logs, "tinfo": tinfo,
+                   "checkpoint": block}
+        self.chunks += 1
+        self.ticks += n
+        self._drain(pending)
+        self.checkpoint_block = block
+        return block
+
+    # --- summary ----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The final ``record: "stream_summary"`` line (also written to
+        the sink): protocol totals, sustained rates, decide-latency
+        tails, the live-buffer watermark, and the checkpoint proof."""
+        from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+        self.flush()
+        s = summarize(self.metrics) if self.metrics else None
+        ttvc = [vc["ticks_to_decide"] for vc in s.view_changes] if s else []
+        wall = time.perf_counter() - self._wall0
+        marks = self._watermarks
+        record = {
+            "record": "stream_summary",
+            "schema_version": SCHEMA_VERSION,
+            "source": "resident",
+            "n": self.n_initial,
+            "capacity": self.capacity,
+            "ticks": self.ticks,
+            "chunks": self.chunks,
+            "chunk_ticks": self.settings.stream_chunk_ticks,
+            "events_injected": self.traffic.events if self.traffic else 0,
+            "joins": self.traffic.joins if self.traffic else 0,
+            "leaves": self.traffic.leaves if self.traffic else 0,
+            "bursts": self.traffic.bursts if self.traffic else 0,
+            "announcements": s.announcements if s else 0,
+            "decisions": s.decisions if s else 0,
+            "wall_s": wall,
+            "ticks_per_sec": _rate(self.ticks, wall),
+            "events_per_sec": _rate(
+                self.traffic.events if self.traffic else 0, wall),
+            "ticks_to_view_change": _dist(ttvc),
+            # ``steady_max`` excludes verify-round-trip chunks, which
+            # transiently hold both the live and the restored branch;
+            # the flat-memory gate reads it.
+            "live_buffer_bytes": {
+                "first": marks[0] if marks else None,
+                "max": max(marks) if marks else None,
+                "steady_max": max(
+                    (r["live_buffer_bytes"] for r in self.chunk_records
+                     if not r["checkpoint"]), default=None),
+                "last": marks[-1] if marks else None,
+            },
+            "traffic": self.traffic.config.as_dict() if self.traffic
+            else None,
+            "checkpoint": self.checkpoint_block,
+        }
+        self._emit(record)
+        return record
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+
+def boot_resident(settings: Settings, capacity: int, n_initial: int, *,
+                  seed: int = 0,
+                  traffic_config: Optional[TrafficConfig] = None,
+                  sink: Optional[str] = None, write_ticks: bool = True,
+                  donate: bool = True) -> ResidentEngine:
+    """Boot a converged ``n_initial``-member cluster with a dormant
+    joiner pool and (optionally) an attached traffic generator."""
+    traffic = None
+    id_fps = None
+    if traffic_config is not None:
+        traffic = TrafficGenerator(traffic_config, settings, capacity,
+                                   n_initial)
+        id_fps = traffic.boot_id_fps()
+    uids = synthetic_uids(capacity, seed)
+    member = np.zeros(capacity, bool)
+    member[:n_initial] = True
+    state = init_state(uids, id_fp_sum=0, settings=settings, member=member,
+                       id_fps=id_fps)
+    faults = crash_faults([I32_MAX] * capacity)
+    return ResidentEngine(state, faults, settings, traffic=traffic,
+                          sink=sink, write_ticks=write_ticks, donate=donate,
+                          n_initial=n_initial)

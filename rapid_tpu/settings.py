@@ -111,6 +111,14 @@ class Settings:
     # recorder-less jaxpr, same discipline as ``invariant_checks``.
     flight_recorder_window: int = 0
 
+    # --- streaming service (rapid_tpu.service) ---
+    # Ticks per resident-engine chunk: the service re-enters the jitted
+    # ``lax.scan`` with the previous chunk's final carry, so one compile
+    # serves the whole stream and host I/O (metrics JSONL, checkpoints)
+    # overlaps the async dispatch of the next chunk. Static — it is the
+    # scan length — so changing it retraces.
+    stream_chunk_ticks: int = 256
+
     # --- randomness ---
     seed: int = 0
 
@@ -132,6 +140,10 @@ class Settings:
             raise ValueError(
                 f"rx_kernel must be one of 'xla', 'packed', 'pallas', "
                 f"got {self.rx_kernel!r}")
+        if self.stream_chunk_ticks < 1:
+            raise ValueError(
+                f"stream_chunk_ticks must be >= 1, got "
+                f"{self.stream_chunk_ticks}")
         if self.rx_epoch_delta_bits not in (8, 16):
             raise ValueError(
                 f"rx_epoch_delta_bits must be 8 or 16, got "

@@ -25,7 +25,7 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 #: Fold semantics of every RunSummary gauge when aggregated over a fleet
 #: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
@@ -458,6 +458,129 @@ PROGRESS_DISPATCH_SPEC = {
     "stages": (dict,),
     "spot_failures": (int,),
     "anomalies": (dict,),
+    # Schema v9: per-dispatch throughput, same null-below-the-floor rate
+    # convention as the streaming records.
+    "ticks_per_sec": (int, float, type(None)),
+    "events_per_sec": (int, float, type(None)),
+}
+
+# --- streaming service records (schema v9) --------------------------------
+
+#: The ``TrafficConfig.as_dict()`` block embedded in streaming records.
+TRAFFIC_CONFIG_SPEC = {
+    "seed": (int,),
+    "join_rate_per_ktick": _NUM,
+    "leave_burst_rate_per_ktick": _NUM,
+    "leave_burst_size": (int,),
+    "diurnal_amplitude": _NUM,
+    "diurnal_period_ticks": (int,),
+    "burst_spacing_ticks": (int,),
+    "max_join_burst": (int,),
+    "min_members": (int,),
+    "reuse_slots": (bool,),
+}
+
+#: Per-chunk traffic lowering counts (``TrafficGenerator.next_chunk``
+#: info block).
+STREAM_TRAFFIC_INFO_SPEC = {
+    "bursts": (int,),
+    "joins": (int,),
+    "leaves": (int,),
+    "backlog_joins": (int,),
+    "backlog_leaves": (int,),
+    "n_members": (int,),
+    "events": (int,),
+}
+
+#: The checkpoint-proof block of a save/restore round trip
+#: (``ResidentEngine.verify_round_trip``). Boolean ``*_identical``
+#: fields are the bit-exactness verdicts; the recorder pair is null when
+#: the run has no flight recorder.
+STREAM_CHECKPOINT_SPEC = {
+    "version": (int,),
+    "tick": (int,),
+    "state_identical": (bool,),
+    "recorder_identical": (bool, type(None)),
+    "logs_identical": (bool,),
+    "final_identical": (bool,),
+    "continuation_recorder_identical": (bool, type(None)),
+}
+
+#: One ``record: "chunk"`` heartbeat of the resident-engine JSONL
+#: stream. ``traffic`` is null when no generator is attached;
+#: ``checkpoint`` is non-null only on the chunk that performed a
+#: save/restore round trip.
+STREAM_CHUNK_SPEC = {
+    "record": (str,),
+    "index": (int,),
+    "tick": (int,),
+    "ticks": (int,),
+    "wall_s": _NUM,
+    "ticks_per_sec": (int, float, type(None)),
+    "events_per_sec": (int, float, type(None)),
+    "announces": (int,),
+    "decides": (int,),
+    "live_buffer_bytes": (int,),
+    "traffic": (dict, type(None)),
+    "checkpoint": (dict, type(None)),
+}
+
+#: Live-buffer watermark block of the stream summary. ``steady_max``
+#: excludes checkpoint-verify chunks (those transiently hold the live
+#: and restored branches side by side) — the flat-memory soak gate
+#: compares it against ``first``.
+STREAM_WATERMARK_SPEC = {
+    "first": _OPT_INT,
+    "max": _OPT_INT,
+    "steady_max": _OPT_INT,
+    "last": _OPT_INT,
+}
+
+#: The final ``record: "stream_summary"`` line of a resident run (also
+#: the ``summary`` block of a committed soak artifact).
+STREAM_SUMMARY_SPEC = {
+    "record": (str,),
+    "schema_version": (int,),
+    "source": (str,),
+    "n": (int,),
+    "capacity": (int,),
+    "ticks": (int,),
+    "chunks": (int,),
+    "chunk_ticks": (int,),
+    "events_injected": (int,),
+    "joins": (int,),
+    "leaves": (int,),
+    "bursts": (int,),
+    "announcements": (int,),
+    "decisions": (int,),
+    "wall_s": _NUM,
+    "ticks_per_sec": (int, float, type(None)),
+    "events_per_sec": (int, float, type(None)),
+    "ticks_to_view_change": (dict,),
+    "live_buffer_bytes": (dict,),
+    "traffic": (dict, type(None)),
+    "checkpoint": (dict, type(None)),
+}
+
+#: ``service.checkpoint`` manifest (``manifest.json`` inside a
+#: checkpoint directory). ``checkpoint_version`` is the restore
+#: compatibility pin — distinct from the telemetry ``schema_version``
+#: the manifest also stamps.
+CHECKPOINT_MANIFEST_SPEC = {
+    "record": (str,),
+    "checkpoint_version": (int,),
+    "schema_version": (int,),
+    "family": (str,),
+    "tick": (int,),
+    "statics": (dict,),
+    "leaves": (list,),
+    "host": (dict, type(None)),
+}
+
+CHECKPOINT_LEAF_SPEC = {
+    "name": (str,),
+    "dtype": (str,),
+    "shape": (list,),
 }
 
 #: Relative slack allowed between a campaign payload's ``wall_s`` and
@@ -732,12 +855,128 @@ def validate_progress_stream(lines, where: str = "progress") -> List[str]:
     return errors
 
 
+def validate_stream_chunk(rec, where: str = "chunk") -> List[str]:
+    """Validate one ``record: "chunk"`` resident heartbeat."""
+    errors = _check(rec, STREAM_CHUNK_SPEC, where)
+    if not isinstance(rec, dict):
+        return errors
+    if isinstance(rec.get("traffic"), dict):
+        errors += _check(rec["traffic"], STREAM_TRAFFIC_INFO_SPEC,
+                         f"{where}.traffic")
+    if isinstance(rec.get("checkpoint"), dict):
+        errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
+                         f"{where}.checkpoint")
+    return errors
+
+
+def validate_stream_summary(rec, where: str = "stream_summary"
+                            ) -> List[str]:
+    """Validate the final ``record: "stream_summary"`` line."""
+    errors = _check(rec, STREAM_SUMMARY_SPEC, where)
+    if not isinstance(rec, dict):
+        return errors
+    errors += _version_errors(rec)
+    if isinstance(rec.get("ticks_to_view_change"), dict):
+        errors += _check(rec["ticks_to_view_change"], DISTRIBUTION_SPEC,
+                         f"{where}.ticks_to_view_change")
+    if isinstance(rec.get("live_buffer_bytes"), dict):
+        errors += _check(rec["live_buffer_bytes"], STREAM_WATERMARK_SPEC,
+                         f"{where}.live_buffer_bytes")
+    if isinstance(rec.get("traffic"), dict):
+        errors += _check(rec["traffic"], TRAFFIC_CONFIG_SPEC,
+                         f"{where}.traffic")
+    if isinstance(rec.get("checkpoint"), dict):
+        errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
+                         f"{where}.checkpoint")
+    return errors
+
+
+def validate_checkpoint_manifest(manifest, where: str = "manifest"
+                                 ) -> List[str]:
+    """Validate a ``service.checkpoint`` ``manifest.json`` payload
+    (structure only — version/statics *compatibility* is the loader's
+    job and raises typed errors there)."""
+    errors = _check(manifest, CHECKPOINT_MANIFEST_SPEC, where)
+    if not isinstance(manifest, dict):
+        return errors
+    for i, leaf in enumerate(manifest.get("leaves") or []):
+        errors += _check(leaf, CHECKPOINT_LEAF_SPEC, f"{where}.leaves[{i}]")
+    return errors
+
+
+def validate_streaming_stream(lines, where: str = "stream") -> List[str]:
+    """Validate a resident-engine JSONL metrics stream: every
+    ``record: "chunk"`` heartbeat, exactly one trailing
+    ``record: "stream_summary"`` line. Tick rows (no ``record`` key)
+    pass through unchecked — their shape is ``TickMetrics.as_dict`` and
+    belongs to the metrics producer."""
+    errors: List[str] = []
+    chunks = 0
+    summaries = 0
+    last_kind = None
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            errors.append(f"{where}[{i}]: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{where}[{i}]: expected an object")
+            continue
+        kind = rec.get("record")
+        last_kind = kind
+        if kind == "chunk":
+            chunks += 1
+            errors += validate_stream_chunk(rec, f"{where}[{i}]")
+        elif kind == "stream_summary":
+            summaries += 1
+            errors += validate_stream_summary(rec, f"{where}[{i}]")
+    if chunks == 0:
+        errors.append(f"{where}: no chunk heartbeat records")
+    if summaries != 1:
+        errors.append(f"{where}: expected exactly one stream_summary "
+                      f"record, found {summaries}")
+    elif last_kind != "stream_summary":
+        errors.append(f"{where}: stream_summary must be the final record")
+    return errors
+
+
+#: Extra required fields of the ``scenario: "streaming"`` bench run
+#: (schema v9) on top of ``RUN_SPEC``.
+STREAMING_RUN_SPEC = {
+    "scenario": (str,),
+    "capacity": (int,),
+    "chunk_ticks": (int,),
+    "chunks": (int,),
+    "events_injected": (int,),
+    "events_per_sec": (int, float, type(None)),
+    "traffic": (dict,),
+    "ticks_to_view_change": (dict,),
+    "checkpoint": (dict, type(None)),
+}
+
+
 def validate_run_payload(payload, where: str = "payload") -> List[str]:
     errors = _check(payload, RUN_SPEC, where)
     if isinstance(payload, dict) and isinstance(payload.get("telemetry"),
                                                 dict):
         errors += validate_telemetry(payload["telemetry"],
                                      f"{where}.telemetry")
+    if isinstance(payload, dict) and payload.get("scenario") == "streaming":
+        errors += _check(payload, STREAMING_RUN_SPEC, where)
+        if isinstance(payload.get("traffic"), dict):
+            errors += _check(payload["traffic"], TRAFFIC_CONFIG_SPEC,
+                             f"{where}.traffic")
+        if isinstance(payload.get("ticks_to_view_change"), dict):
+            errors += _check(payload["ticks_to_view_change"],
+                             DISTRIBUTION_SPEC,
+                             f"{where}.ticks_to_view_change")
+        if isinstance(payload.get("checkpoint"), dict):
+            errors += _check(payload["checkpoint"], STREAM_CHECKPOINT_SPEC,
+                             f"{where}.checkpoint")
     if isinstance(payload, dict) and "campaign" in payload:
         errors += validate_campaign(payload["campaign"], f"{where}.campaign")
         # Schema v5: a campaign payload must carry the dispatch
@@ -851,7 +1090,7 @@ def validate_bench_payload(payload) -> List[str]:
         return errors + validate_profile_payload(payload)
     if payload.get("bench") == "engine_tick_suite":
         for key in ("steady", "churn", "contested", "partition", "delay",
-                    "fleet"):
+                    "streaming", "fleet"):
             if key not in payload:
                 errors.append(f"payload.{key}: missing")
             else:
@@ -876,9 +1115,18 @@ def main(argv=None) -> int:
             return 1
         print(f"progress schema ok: {argv[1]}")
         return 0
+    if len(argv) == 2 and argv[0] == "--streaming":
+        with open(argv[1], "r", encoding="utf-8") as fh:
+            errors = validate_streaming_stream(fh.readlines())
+        if errors:
+            for e in errors:
+                print(f"schema violation: {e}", file=sys.stderr)
+            return 1
+        print(f"streaming schema ok: {argv[1]}")
+        return 0
     if len(argv) != 1:
         print("usage: python -m rapid_tpu.telemetry.schema "
-              "[--progress] FILE", file=sys.stderr)
+              "[--progress|--streaming] FILE", file=sys.stderr)
         return 2
     with open(argv[0], "rb") as fh:
         raw = fh.read()

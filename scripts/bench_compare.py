@@ -47,14 +47,21 @@ from rapid_tpu.telemetry.schema import validate_bench_payload  # noqa: E402
 
 #: Run-config keys that must match for the count comparison to mean
 #: anything; a mismatch is an error telling the caller to regenerate.
-CONFIG_KEYS = ("n", "ticks", "k", "clusters", "fleet_size")
+CONFIG_KEYS = ("n", "ticks", "k", "clusters", "fleet_size", "capacity",
+               "chunk_ticks")
 
 #: Deterministic protocol counts at the run level (compared when present
-#: on either side — scenarios carry different subsets).
+#: on either side — scenarios carry different subsets). The streaming
+#: entry's traffic (seeded arrival process), chunk structure,
+#: decide-latency tail, and checkpoint bit-exactness verdicts are all
+#: deterministic, so they gate exactly like any other protocol count;
+#: its ``events_per_sec`` rate is wall-clock and stays warn-only.
 PROTOCOL_RUN_KEYS = (
     "announcements", "decisions", "final_members", "crashed_nodes",
     "churn_bursts", "burst_size", "contested_instances",
     "ticks_to_first_decide", "messages_per_view_change",
+    "events_injected", "joins", "leaves", "bursts", "chunks",
+    "traffic", "ticks_to_view_change", "checkpoint",
 )
 
 #: Seed-deterministic structural fields of one dispatch_timeline record
@@ -149,6 +156,7 @@ def compare_run(current: Dict, baseline: Dict, where: str,
     # can be watched tighter or looser than raw tick throughput.
     rate_tolerances = (
         ("ticks_per_sec", tps_tolerance),
+        ("events_per_sec", tps_tolerance),
         ("clusters_per_sec",
          tps_tolerance if cps_tolerance is None else cps_tolerance),
     )
@@ -305,7 +313,7 @@ def compare_payloads(current: Dict, baseline: Dict,
         errors: List[str] = []
         warnings: List[str] = []
         for key in ("steady", "churn", "contested", "partition", "delay",
-                    "fleet"):
+                    "streaming", "fleet"):
             e, w = compare_run(current.get(key) or {},
                                baseline.get(key) or {},
                                f"payload.{key}", tps_tolerance,

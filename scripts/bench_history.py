@@ -12,6 +12,13 @@ per-round trend of the throughput figures that matter (per-suite
 ticks/sec, the fleet campaign's clusters/sec) against the committed
 baseline.
 
+``SOAK_rNN.json`` records (same ``{n, rc, tail}`` shape, capturing a
+``python -m rapid_tpu.service --soak`` run) are folded too: the
+streaming columns come from the final ``stream_summary`` heartbeat on
+the tail's last line, and a soak round whose tail does *not* end in
+that record is flagged as having lost its final heartbeat — the soak
+died between its last chunk and the summary flush.
+
 Dead records are the whole point: a round whose ``tail`` is empty or
 whose ``parsed`` is null means the bench ran but its output was lost —
 historically a wall-budget kill with nothing flushed (``bench.py`` now
@@ -38,8 +45,11 @@ from typing import Dict, List, Optional
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: Suite entries whose ticks_per_sec trend is worth a column (matches
-#: bench.py's SUITE_ENTRIES; fleet reports clusters_per_sec instead).
-RATE_ENTRIES = ("steady", "churn", "contested", "partition", "delay")
+#: bench.py's SUITE_ENTRIES; fleet reports clusters_per_sec instead —
+#: streaming additionally reports events/sec and the p99
+#: ticks-to-view-change tail in their own columns).
+RATE_ENTRIES = ("steady", "churn", "contested", "partition", "delay",
+                "streaming")
 
 
 def _round_no(path: str, record: Dict) -> int:
@@ -91,6 +101,20 @@ def _rx_rate(fleet: Optional[Dict]) -> Optional[float]:
     return member_ticks / execute_s
 
 
+def _streaming_cols(parsed: Optional[Dict]) -> Dict[str, Optional[float]]:
+    """The streaming entry's load figures: sustained events/sec and the
+    p99 ticks-to-view-change tail under that load. None for payloads
+    predating the streaming entry (schema < 9)."""
+    entry = parsed.get("streaming") if isinstance(parsed, dict) else None
+    if not isinstance(entry, dict):
+        return {"streaming_events_per_sec": None,
+                "streaming_ttvc_p99": None}
+    ttvc = entry.get("ticks_to_view_change")
+    return {"streaming_events_per_sec": _rate(entry, "events_per_sec"),
+            "streaming_ttvc_p99": _rate(ttvc, "p99")
+            if isinstance(ttvc, dict) else None}
+
+
 def _fold_bench(path: str) -> Dict[str, object]:
     """One BENCH_rNN.json -> a trend row (never raises: unreadable
     records become dead rows, which is exactly what we report)."""
@@ -133,6 +157,64 @@ def _fold_bench(path: str) -> Dict[str, object]:
     row["clusters_per_sec"] = _rate(parsed.get("fleet"),
                                     "clusters_per_sec")
     row["rx_member_ticks_per_sec"] = _rx_rate(parsed.get("fleet"))
+    row.update(_streaming_cols(parsed))
+    return row
+
+
+def _fold_soak(path: str) -> Dict[str, object]:
+    """One SOAK_rNN.json capture record -> a trend row.
+
+    Soak captures mirror the bench ones (``{n, rc, tail}`` with the tail
+    holding the soak's stdout) but their contract is different: the last
+    stdout line must be the resident service's final ``stream_summary``
+    heartbeat. A round whose tail ends in anything else *lost its final
+    heartbeat* — the soak died (or was killed) between its last chunk
+    and the summary flush — and is flagged exactly like a dead bench
+    round.
+    """
+    row: Dict[str, object] = {"path": os.path.basename(path),
+                              "round": -1, "rc": None, "dead": True,
+                              "lost_final_heartbeat": True,
+                              "ticks": None, "events_per_sec": None,
+                              "ttvc_p99": None, "checkpoint_ok": None,
+                              "problems": []}
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as err:
+        row["problems"].append(f"unreadable record: {err}")
+        return row
+    row["round"] = _round_no(path, record)
+    row["rc"] = record.get("rc")
+    tail = record.get("tail")
+    if not isinstance(tail, str) or not tail.strip():
+        row["problems"].append("empty tail — soak output lost")
+        return row
+    row["dead"] = False
+    try:
+        summary = json.loads(tail.strip().splitlines()[-1])
+    except ValueError:
+        summary = None
+    if not isinstance(summary, dict) or \
+            summary.get("record") != "stream_summary":
+        row["problems"].append(
+            "lost final heartbeat — tail does not end in a "
+            "stream_summary record")
+        return row
+    row["lost_final_heartbeat"] = False
+    ttvc = summary.get("ticks_to_view_change")
+    ck = summary.get("checkpoint")
+    row.update(
+        ticks=summary.get("ticks"),
+        events_per_sec=_rate(summary, "events_per_sec"),
+        ttvc_p99=_rate(ttvc, "p99") if isinstance(ttvc, dict) else None,
+        checkpoint_ok=all(ck.get(key) for key in
+                          ("state_identical", "logs_identical",
+                           "final_identical"))
+        if isinstance(ck, dict) else None)
+    if row["checkpoint_ok"] is False:
+        row["problems"].append("mid-soak checkpoint round trip was not "
+                               "bit-identical")
     return row
 
 
@@ -159,16 +241,18 @@ def _baseline_row(path: str) -> Optional[Dict[str, object]]:
         return None
     with open(path) as fh:
         baseline = json.load(fh)
-    return {"path": os.path.relpath(path, _REPO), "round": None,
-            "rc": 0, "dead": False, "partial": None,
-            "config": {"n": baseline.get("n"),
-                       "ticks": baseline.get("ticks")},
-            "rates": {name: _rate(baseline.get(name), "ticks_per_sec")
-                      for name in RATE_ENTRIES},
-            "clusters_per_sec": _rate(baseline.get("fleet"),
-                                      "clusters_per_sec"),
-            "rx_member_ticks_per_sec": _rx_rate(baseline.get("fleet")),
-            "problems": []}
+    row = {"path": os.path.relpath(path, _REPO), "round": None,
+           "rc": 0, "dead": False, "partial": None,
+           "config": {"n": baseline.get("n"),
+                      "ticks": baseline.get("ticks")},
+           "rates": {name: _rate(baseline.get(name), "ticks_per_sec")
+                     for name in RATE_ENTRIES},
+           "clusters_per_sec": _rate(baseline.get("fleet"),
+                                     "clusters_per_sec"),
+           "rx_member_ticks_per_sec": _rx_rate(baseline.get("fleet")),
+           "problems": []}
+    row.update(_streaming_cols(baseline))
+    return row
 
 
 def _fmt(value: Optional[float]) -> str:
@@ -184,12 +268,18 @@ def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
     multichip_rows = [_fold_multichip(p) for p in
                       sorted(glob.glob(os.path.join(
                           directory, "MULTICHIP_r*.json")))]
+    soak_rows = [_fold_soak(p) for p in
+                 sorted(glob.glob(os.path.join(directory,
+                                               "SOAK_r*.json")))]
     return {"record": "bench_history",
             "directory": directory,
             "baseline": _baseline_row(baseline_path),
             "rounds": bench_rows,
             "multichip": multichip_rows,
-            "dead_rounds": [r["path"] for r in bench_rows if r["dead"]],
+            "soak": soak_rows,
+            "dead_rounds": [r["path"] for r in bench_rows if r["dead"]]
+                           + [r["path"] for r in soak_rows
+                              if r["dead"] or r["lost_final_heartbeat"]],
             "partial_rounds": [r["path"] for r in bench_rows
                                if r["partial"]]}
 
@@ -197,7 +287,8 @@ def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
 def render(report: Dict[str, object]) -> str:
     lines = []
     header = (["round", "rc"] + list(RATE_ENTRIES)
-              + ["fleet cl/s", "rx mt/s", "flags"])
+              + ["str ev/s", "str p99", "fleet cl/s", "rx mt/s",
+                 "flags"])
     rows: List[List[str]] = []
     baseline = report["baseline"]
     for row in ([baseline] if baseline else []) + list(report["rounds"]):
@@ -207,7 +298,9 @@ def render(report: Dict[str, object]) -> str:
         rows.append([label, str(row["rc"])]
                     + [_fmt(row["rates"].get(name))
                        for name in RATE_ENTRIES]
-                    + [_fmt(row["clusters_per_sec"]),
+                    + [_fmt(row.get("streaming_events_per_sec")),
+                       _fmt(row.get("streaming_ttvc_p99")),
+                       _fmt(row["clusters_per_sec"]),
                        _fmt(row.get("rx_member_ticks_per_sec")), flags])
     widths = [max(len(header[i]), *(len(r[i]) for r in rows))
               if rows else len(header[i]) for i in range(len(header))]
@@ -218,6 +311,19 @@ def render(report: Dict[str, object]) -> str:
         state = ("ok" if row["ok"] else
                  "skipped" if row["skipped"] else "FAILED")
         lines.append(f"multichip r{row['round']:02d}: {state} "
+                     f"(rc={row['rc']})")
+    for row in report.get("soak", []):
+        if row["dead"]:
+            state = "DEAD"
+        elif row["lost_final_heartbeat"]:
+            state = "LOST FINAL HEARTBEAT"
+        elif row["checkpoint_ok"] is False:
+            state = "CHECKPOINT MISMATCH"
+        else:
+            state = (f"ok ({row['ticks']} ticks, "
+                     f"{_fmt(row['events_per_sec'])} ev/s, "
+                     f"ttvc p99 {_fmt(row['ttvc_p99'])})")
+        lines.append(f"soak r{row['round']:02d}: {state} "
                      f"(rc={row['rc']})")
     return "\n".join(lines)
 
@@ -238,12 +344,13 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     report = build_report(args.dir, args.baseline)
-    if not report["rounds"] and not report["multichip"]:
-        print(f"bench_history: no BENCH_r*/MULTICHIP_r* records under "
-              f"{args.dir}", file=sys.stderr)
+    if not report["rounds"] and not report["multichip"] \
+            and not report["soak"]:
+        print(f"bench_history: no BENCH_r*/MULTICHIP_r*/SOAK_r* records "
+              f"under {args.dir}", file=sys.stderr)
         return 1
     print(render(report))
-    for row in report["rounds"] + report["multichip"]:
+    for row in report["rounds"] + report["multichip"] + report["soak"]:
         for problem in row["problems"]:
             print(f"bench_history: WARNING: {row['path']}: {problem}",
                   file=sys.stderr)

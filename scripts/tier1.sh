@@ -14,7 +14,7 @@ else
 fi
 
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -330,6 +330,38 @@ sys.exit(1)') \
         echo TRIAGE_SMOKE=ok
     else
         echo TRIAGE_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Streaming-soak smoke: the resident service must run >=2k ticks as
+# donated chunked scans under open-loop traffic, perform one mid-soak
+# checkpoint save/restore round trip (the CLI itself exits 1 unless the
+# restored carry, continuation logs, final state and recorder ring are
+# all bit-identical and the steady live-buffer watermark stayed flat),
+# emit a schema-valid streaming JSONL stream, and print a parseable
+# stream_summary line whose checkpoint block carries the proof.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.service \
+            --soak --ticks 2048 --chunk 256 --n 16 --capacity 48 \
+            --recorder 8 --no-tick-rows --out /tmp/_t1_soak.jsonl \
+            > /tmp/_t1_soak.out \
+        && python -m rapid_tpu.telemetry.schema --streaming \
+            /tmp/_t1_soak.jsonl \
+        && tail -n 1 /tmp/_t1_soak.out | python -c '
+import json, sys
+s = json.loads(sys.stdin.read())
+ck = s["checkpoint"]
+ok = (s["record"] == "stream_summary"
+      and s["ticks"] >= 2048
+      and ck["state_identical"] and ck["logs_identical"]
+      and ck["final_identical"] and ck["recorder_identical"]
+      and ck["continuation_recorder_identical"]
+      and s["events_injected"] > 0 and s["decisions"] > 0)
+sys.exit(0 if ok else 1)'; then
+        echo SOAK_SMOKE=ok
+    else
+        echo SOAK_SMOKE=failed
         rc=1
     fi
 fi

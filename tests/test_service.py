@@ -1,0 +1,391 @@
+"""Streaming service mode: resident driver, checkpoint/restore, traffic.
+
+The load-bearing proofs:
+
+- chunked ``simulate_chunk`` chains are bit-identical to one
+  uninterrupted scan (engine and receiver, dense and packed carries,
+  flight recorder included);
+- a checkpoint save/load round trip is bit-exact for every family
+  (engine, receiver_dense, receiver_packed under ``"packed"`` *and*
+  ``"pallas"``), and a restored carry *continues* byte-identically;
+- restore is strict: version mismatch raises ``CheckpointVersionError``
+  naming saved vs expected, statics mismatch raises
+  ``CheckpointCompatError`` naming every differing field, leaf drift
+  raises ``CheckpointError``;
+- the traffic generator is chunk-split invariant (10x100 ticks draw the
+  same events as 1x1000), stays inside the churn envelope, and its
+  generated history replays exactly through the host oracle referee
+  (``run_churn_differential``);
+- the ``two_zone`` preset (``faults``) yields schedules the device
+  receiver reproduces bit-identically;
+- the resident engine's JSONL stream validates, and a mid-run
+  save/restore resumes bit-identically (traffic rng included).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rapid_tpu.engine import rx_packed
+from rapid_tpu.engine.churn import empty_schedule
+from rapid_tpu.engine.diff import (run_churn_differential,
+                                   run_receiver_differential)
+from rapid_tpu.engine.fleet import lower_receiver_schedule
+from rapid_tpu.engine.receiver import receiver_simulate, receiver_simulate_chunk
+from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+from rapid_tpu.engine.step import simulate, simulate_chunk
+from rapid_tpu.faults import (DelayBudgetError, scenario_weights_preset,
+                              sample_adversary_schedule, two_zone_schedule)
+from rapid_tpu.service import (CheckpointCompatError, CheckpointError,
+                               CheckpointVersionError, ResidentEngine,
+                               TrafficConfig, TrafficGenerator, boot_resident,
+                               load_checkpoint, restore_receiver_carry,
+                               save_engine, save_receiver)
+from rapid_tpu.service.resident import synthetic_uids
+from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry.schema import (validate_checkpoint_manifest,
+                                        validate_streaming_stream)
+
+SETTINGS = Settings()
+REC = SETTINGS.with_(flight_recorder_window=8)
+PACKED_REC = REC.with_(rx_kernel="packed")
+PALLAS_REC = REC.with_(rx_kernel="pallas")
+
+TRAFFIC = TrafficConfig(seed=7, join_rate_per_ktick=60.0,
+                        leave_burst_rate_per_ktick=8.0, leave_burst_size=2,
+                        diurnal_amplitude=0.4, diurnal_period_ticks=256)
+
+
+def _tree_equal(a, b, what="tree"):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb), f"{what}: leaf count {len(la)} != {len(lb)}"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{what}: leaf {i} diverged"
+
+
+def _concat_logs(parts):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+        *parts)
+
+
+def _boot_engine(n=10, capacity=24, settings=SETTINGS, seed=0,
+                 traffic=None):
+    id_fps = traffic.boot_id_fps() if traffic is not None else None
+    member = np.zeros(capacity, bool)
+    member[:n] = True
+    state = init_state(synthetic_uids(capacity, seed), id_fp_sum=0,
+                       settings=settings, member=member, id_fps=id_fps)
+    return state, crash_faults([I32_MAX] * capacity)
+
+
+def _receiver_member(settings, n=12, seed=3):
+    sched = two_zone_schedule(n, seed, 60,
+                              ring_depth=settings.delivery_ring_depth)
+    return lower_receiver_schedule(sched, settings)
+
+
+# ---------------------------------------------------------------------------
+# chunked scans == one uninterrupted scan
+# ---------------------------------------------------------------------------
+
+
+def test_engine_chunked_bit_identical_with_churn_and_recorder():
+    gen = TrafficGenerator(TRAFFIC, REC, capacity=24, n_initial=10)
+    state, faults = _boot_engine(settings=REC, traffic=gen)
+    sched, info = gen.next_chunk(64)
+    assert info["events"] > 0 and sched is not None
+    want_final, want_logs, want_rec = simulate(state, faults, 64, REC,
+                                               churn=sched)
+    # Enqueue ticks are absolute, so the full-window schedule is inert
+    # outside each chunk's tick range — both chunks can share it.
+    f1, l1, r1 = simulate_chunk(state, faults, 32, REC, churn=sched,
+                                donate=False)
+    f2, l2, r2 = simulate_chunk(f1, faults, 32, REC, churn=sched, rec=r1,
+                                donate=False)
+    _tree_equal(f2, want_final, "final state")
+    _tree_equal(_concat_logs([l1, l2]), want_logs, "logs")
+    _tree_equal(r2, want_rec, "recorder ring")
+
+
+@pytest.mark.parametrize("settings", [REC, PACKED_REC],
+                         ids=["dense", "packed"])
+def test_receiver_chunked_bit_identical(settings):
+    member = _receiver_member(settings)
+    want = receiver_simulate_chunk(member.state, member.faults, 40,
+                                   settings, donate=False)
+    carry, logs, rec = member.state, [], None
+    for _ in range(2):
+        carry, log, rec = receiver_simulate_chunk(
+            carry, member.faults, 20, settings, rec=rec, donate=False)
+        logs.append(log)
+    _tree_equal(carry, want[0], "final carry")
+    _tree_equal(_concat_logs(logs), want[1], "logs")
+    _tree_equal(rec, want[2], "recorder ring")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round trips: bit-exact restore + bit-identical continuation
+# ---------------------------------------------------------------------------
+
+
+def test_engine_checkpoint_round_trip_continues_identically(tmp_path):
+    state, faults = _boot_engine(settings=REC)
+    live, logs, rec = simulate_chunk(state, faults, 32, REC, donate=False)
+    manifest = save_engine(str(tmp_path / "ck"), live, REC, rec=rec,
+                           host={"note": "test"})
+    assert validate_checkpoint_manifest(manifest) == []
+    cp = load_checkpoint(str(tmp_path / "ck"), REC)
+    assert cp.family == "engine" and cp.tick == 32
+    assert cp.host == {"note": "test"}
+    _tree_equal(cp.parts["state"], live, "restored engine state")
+    _tree_equal(cp.parts["recorder"], rec, "restored recorder")
+    a = simulate_chunk(live, faults, 32, REC, rec=rec, donate=False)
+    b = simulate_chunk(cp.parts["state"], faults, 32, REC,
+                       rec=cp.parts["recorder"], donate=False)
+    _tree_equal(a[0], b[0], "continuation final")
+    _tree_equal(a[1], b[1], "continuation StepLog")
+    _tree_equal(a[2], b[2], "continuation recorder")
+
+
+@pytest.mark.parametrize("settings", [REC, PACKED_REC, PALLAS_REC],
+                         ids=["dense", "packed", "pallas"])
+def test_receiver_checkpoint_round_trip_continues_identically(
+        settings, tmp_path):
+    # 20-tick chunks share the jit cache with the chunked test above.
+    member = _receiver_member(settings)
+    carry, _, rec = receiver_simulate_chunk(member.state, member.faults,
+                                            20, settings, donate=False)
+    save_receiver(str(tmp_path / "ck"), carry, settings, tick=20, rec=rec)
+    cp = load_checkpoint(str(tmp_path / "ck"), settings)
+    want_family = ("receiver_dense" if settings.rx_kernel == "xla"
+                   else "receiver_packed")
+    assert cp.family == want_family
+    restored = restore_receiver_carry(cp, settings)
+    _tree_equal(restored, carry, "restored receiver carry")
+    _tree_equal(cp.parts["recorder"], rec, "restored recorder")
+    a = receiver_simulate_chunk(carry, member.faults, 20, settings,
+                                rec=rec, donate=False)
+    b = receiver_simulate_chunk(restored, member.faults, 20, settings,
+                                rec=cp.parts["recorder"], donate=False)
+    _tree_equal(a[0], b[0], "continuation final")
+    _tree_equal(a[1], b[1], "continuation logs")
+    _tree_equal(a[2], b[2], "continuation recorder")
+
+
+def test_checkpoint_version_mismatch_is_structured(tmp_path):
+    state, _ = _boot_engine()
+    save_engine(str(tmp_path / "ck"), state, SETTINGS)
+    mpath = tmp_path / "ck" / "manifest.json"
+    manifest = json.loads(mpath.read_text())
+    manifest["checkpoint_version"] = 99
+    mpath.write_text(json.dumps(manifest))
+    with pytest.raises(CheckpointVersionError) as exc:
+        load_checkpoint(str(tmp_path / "ck"), SETTINGS)
+    assert exc.value.saved == 99 and exc.value.expected == 1
+    assert "99" in str(exc.value) and "1" in str(exc.value)
+
+
+def test_checkpoint_statics_mismatch_names_fields(tmp_path):
+    member = _receiver_member(PACKED_REC)
+    carry, _, rec = receiver_simulate_chunk(member.state, member.faults,
+                                            20, PACKED_REC, donate=False)
+    save_receiver(str(tmp_path / "ck"), carry, PACKED_REC, tick=20, rec=rec)
+    with pytest.raises(CheckpointCompatError) as exc:
+        load_checkpoint(str(tmp_path / "ck"), PALLAS_REC)
+    assert set(exc.value.mismatches) == {"rx_kernel"}
+    assert "rx_kernel" in str(exc.value)
+    with pytest.raises(CheckpointCompatError) as exc:
+        load_checkpoint(str(tmp_path / "ck"),
+                        PACKED_REC.with_(flight_recorder_window=16))
+    assert "flight_recorder_window" in exc.value.mismatches
+
+
+def test_checkpoint_leaf_drift_rejected(tmp_path):
+    state, _ = _boot_engine()
+    save_engine(str(tmp_path / "ck"), state, SETTINGS)
+    mpath = tmp_path / "ck" / "manifest.json"
+    manifest = json.loads(mpath.read_text())
+    manifest["leaves"] = manifest["leaves"][:-1]
+    mpath.write_text(json.dumps(manifest))
+    with pytest.raises(CheckpointError, match="leaf table"):
+        load_checkpoint(str(tmp_path / "ck"), SETTINGS)
+
+
+# ---------------------------------------------------------------------------
+# traffic generator: determinism, envelope, oracle replay
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_chunk_split_invariance():
+    settings = SETTINGS.with_(stream_chunk_ticks=800)
+    one = TrafficGenerator(TRAFFIC, settings, capacity=32, n_initial=10)
+    many = TrafficGenerator(TRAFFIC, settings, capacity=32, n_initial=10)
+    sched, _ = one.next_chunk(800)
+    for _ in range(8):
+        many.next_chunk(100)
+    assert one._calls == many._calls
+    assert one.events == many.events > 0
+    assert (one.joins, one.leaves, one.bursts) == \
+        (many.joins, many.leaves, many.bursts)
+    assert one.state_dict() == many.state_dict()
+
+
+def test_traffic_envelope_and_schedule_shape():
+    settings = SETTINGS.with_(stream_chunk_ticks=1200)
+    gen = TrafficGenerator(TRAFFIC, settings, capacity=32, n_initial=10)
+    schedule, info = gen.next_chunk(1200)
+    assert info["events"] == info["joins"] + info["leaves"] > 0
+    ticks = sorted(t for _, t, _ in gen._calls)
+    spacing = SETTINGS.churn_decide_delay_ticks + 3
+    assert all(b - a >= spacing for a, b in zip(ticks, ticks[1:]))
+    assert min(ticks) >= spacing
+    # A slot may join then leave inside one window (one enqueue per
+    # field), but never the reverse: rejoin is blocked by the recycle
+    # delay, so wherever both fields are set the join precedes.
+    jt = np.asarray(schedule.join_tick)
+    lt = np.asarray(schedule.leave_tick)
+    both = (jt != I32_MAX) & (lt != I32_MAX)
+    assert (jt[both] < lt[both]).all()
+    # Leave bursts never cross the membership floor.
+    assert info["n_members"] >= TRAFFIC.min_members
+
+
+def test_traffic_replays_through_oracle_referee():
+    config = TrafficConfig(seed=11, join_rate_per_ktick=50.0,
+                           leave_burst_rate_per_ktick=8.0,
+                           leave_burst_size=2, min_members=6,
+                           reuse_slots=False)
+    gen = TrafficGenerator(config, SETTINGS, capacity=24, n_initial=8)
+    ticks = 420
+    for _ in range(4):
+        gen.next_chunk(ticks // 4)
+    assert gen.events > 0
+    joins, leaves = gen.churn_calls(SETTINGS)
+    res = run_churn_differential(n=8, capacity=24, n_ticks=ticks,
+                                 joins=joins, leaves=leaves,
+                                 settings=SETTINGS)
+    res.assert_identical()
+
+
+def test_traffic_churn_calls_requires_no_slot_reuse():
+    gen = TrafficGenerator(TRAFFIC, SETTINGS, capacity=32, n_initial=10)
+    with pytest.raises(ValueError, match="reuse_slots"):
+        gen.churn_calls(SETTINGS)
+
+
+def test_traffic_state_dict_round_trip_resumes_stream():
+    a = TrafficGenerator(TRAFFIC, SETTINGS, capacity=32, n_initial=10)
+    a.next_chunk(256)
+    b = TrafficGenerator.from_state(a.state_dict(), SETTINGS)
+    sa, ia = a.next_chunk(256)
+    sb, ib = b.next_chunk(256)
+    assert ia == ib
+    if sa is None:
+        assert sb is None
+    else:
+        _tree_equal(sa, sb, "resumed schedule")
+
+
+def test_traffic_oversized_window_rejected_not_corrupted():
+    config = TrafficConfig(seed=1, join_rate_per_ktick=80.0,
+                           leave_burst_rate_per_ktick=12.0)
+    gen = TrafficGenerator(config, SETTINGS, capacity=20, n_initial=10)
+    # A window far past the slot-recycle delay eventually revisits a
+    # slot, which one per-slot enqueue-tick schedule cannot encode.
+    with pytest.raises(ValueError, match="slot-recycle delay"):
+        for _ in range(4):
+            gen.next_chunk(4000)
+
+
+# ---------------------------------------------------------------------------
+# two_zone preset
+# ---------------------------------------------------------------------------
+
+
+def test_two_zone_schedule_deterministic_and_budget_checked():
+    a = two_zone_schedule(16, 5, 80)
+    b = two_zone_schedule(16, 5, 80)
+    assert a == b
+    assert len(a.delays) == 1 and a.crashes
+    zone_b = set(range(8, 16))
+    assert {slot for slot, _ in a.crashes} <= zone_b
+    with pytest.raises(DelayBudgetError):
+        two_zone_schedule(16, 5, 80, ring_depth=2)
+
+
+def test_two_zone_preset_lookup():
+    weights = scenario_weights_preset("two_zone")
+    assert weights.slow_asym > 0 and weights.partition == 0
+    sc = sample_adversary_schedule(16, 9, 80, weights)
+    assert sc.kind in ("slow_asym", "crash")
+    with pytest.raises(ValueError, match="unknown scenario-weights"):
+        scenario_weights_preset("nope")
+
+
+def test_two_zone_device_exact():
+    schedule = two_zone_schedule(16, 2, 80)
+    res = run_receiver_differential(schedule, 80, SETTINGS)
+    res.assert_identical()
+    assert res.engine_phase_counters == res.oracle_phase_counters
+    assert res.engine_config_ids == res.oracle_config_ids
+
+
+# ---------------------------------------------------------------------------
+# resident engine: stream validity + save/restore resume
+# ---------------------------------------------------------------------------
+
+
+def _resident_settings():
+    return REC.with_(stream_chunk_ticks=64)
+
+
+def test_resident_stream_validates_and_memory_stays_flat(tmp_path):
+    settings = _resident_settings()
+    sink = str(tmp_path / "stream.jsonl")
+    eng = boot_resident(settings, capacity=24, n_initial=10, seed=0,
+                        traffic_config=TRAFFIC, sink=sink,
+                        write_ticks=False)
+    eng.run(2)
+    eng.verify_round_trip(str(tmp_path / "ck"))
+    eng.run(2)
+    summary = eng.summary()
+    eng.close()
+    with open(sink) as fh:
+        lines = fh.readlines()
+    assert validate_streaming_stream(lines) == []
+    ck = summary["checkpoint"]
+    assert ck["state_identical"] and ck["logs_identical"]
+    assert ck["final_identical"] and ck["recorder_identical"]
+    assert ck["continuation_recorder_identical"]
+    assert summary["ticks"] == 5 * 64 and summary["chunks"] == 5
+    marks = summary["live_buffer_bytes"]
+    assert marks["steady_max"] is not None
+    assert marks["steady_max"] <= marks["max"]
+
+
+def test_resident_save_restore_resumes_bit_identically(tmp_path):
+    settings = _resident_settings()
+    eng = boot_resident(settings, capacity=24, n_initial=10, seed=0,
+                        traffic_config=TRAFFIC)
+    eng.run(2)
+    path = str(tmp_path / "ck")
+    eng.save(path)
+    faults = crash_faults([I32_MAX] * 24)
+    twin = ResidentEngine.restore(path, faults, settings)
+    assert twin.chunks == eng.chunks and twin.ticks == eng.ticks
+    assert twin.traffic.state_dict() == eng.traffic.state_dict()
+    eng.run(2)
+    twin.run(2)
+    _tree_equal(twin.state, eng.state, "resumed engine state")
+    _tree_equal(twin._rec, eng._rec, "resumed recorder ring")
+    assert twin.traffic.state_dict() == eng.traffic.state_dict()
+    eng.close()
+    twin.close()

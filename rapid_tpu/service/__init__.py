@@ -1,19 +1,29 @@
 """Streaming service mode: the engine as a long-lived membership process.
 
-Three pieces (see ``ROADMAP.md`` "Streaming service mode"):
+Six pieces (see ``ROADMAP.md`` "Streaming service mode" and "Streaming
+observatory"):
 
 - ``resident`` — the chunked, donated, double-buffered driver around
   ``engine.step.simulate_chunk``, streaming ``TickMetrics`` JSONL;
+- ``rx_resident`` — the per-receiver twin around
+  ``engine.receiver.receiver_simulate_chunk`` (layout-preserving: dense
+  or packed carry), with the same heartbeats and checkpoint proof;
 - ``checkpoint`` — versioned save/restore of every scan carry family
   (engine, dense receiver, packed receiver, recorder ring), proven
   bit-identical across the save/load boundary;
-- ``traffic`` — the seeded open-loop arrival processes (Poisson joins,
-  correlated leave bursts, diurnal waves) lowered chunk-by-chunk onto
-  ``ChurnSchedule``.
+- ``traffic`` — the seeded arrival processes (Poisson joins, correlated
+  leave bursts, diurnal waves) lowered chunk-by-chunk onto
+  ``ChurnSchedule``; ``closed_loop=True`` samples joins by CDF
+  inversion from one uniform per tick, so rate changes never shift the
+  seeded stream;
+- ``servo`` — the deterministic target-rate load servo (events/sec ->
+  quantized events/ktick from committed heartbeat walls);
+- ``status`` — the read-only live status API (atomic status file +
+  unix-socket line protocol with ``watch`` subscriptions).
 
-``python -m rapid_tpu.service --soak`` runs the long-haul gate: >=100k
-ticks in chunks at constant memory with one mid-soak save/restore
-round-trip proven bit-identical.
+``python -m rapid_tpu.service --soak`` runs the long-haul gate;
+``--load-sweep`` drives the saturation sweep that locates the knee;
+``--rx-soak`` runs the packed receiver-resident soak.
 """
 from rapid_tpu.service.checkpoint import (
     CHECKPOINT_VERSION,
@@ -28,6 +38,11 @@ from rapid_tpu.service.checkpoint import (
     save_receiver,
 )
 from rapid_tpu.service.resident import ResidentEngine, boot_resident
+from rapid_tpu.service.rx_resident import (ResidentReceiver,
+                                           boot_resident_receiver)
+from rapid_tpu.service.servo import LoadServo, ServoConfig
+from rapid_tpu.service.status import (StatusFile, StatusPublisher,
+                                      StatusSocket, read_status)
 from rapid_tpu.service.traffic import TrafficConfig, TrafficGenerator
 
 __all__ = [
@@ -36,11 +51,19 @@ __all__ = [
     "CheckpointCompatError",
     "CheckpointError",
     "CheckpointVersionError",
+    "LoadServo",
     "ResidentEngine",
+    "ResidentReceiver",
+    "ServoConfig",
+    "StatusFile",
+    "StatusPublisher",
+    "StatusSocket",
     "TrafficConfig",
     "TrafficGenerator",
     "boot_resident",
+    "boot_resident_receiver",
     "load_checkpoint",
+    "read_status",
     "restore_receiver_carry",
     "save_checkpoint",
     "save_engine",

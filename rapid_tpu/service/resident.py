@@ -49,10 +49,13 @@ from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
                                     crash_faults, init_state)
 from rapid_tpu.engine.step import simulate_chunk
 from rapid_tpu.service import checkpoint as checkpoint_mod
+from rapid_tpu.service.servo import LoadServo
+from rapid_tpu.service.status import StatusPublisher
 from rapid_tpu.service.traffic import TrafficConfig, TrafficGenerator
 from rapid_tpu.settings import Settings
 from rapid_tpu.telemetry import engine_metrics, json_artifact_line, summarize
 from rapid_tpu.telemetry.metrics import _dist
+from rapid_tpu.telemetry.slo import SloWindows, ViewChangeFold
 
 # One rate convention across campaign heartbeats and the service stream:
 # a wall below the floor reports null instead of a garbage rate.
@@ -100,6 +103,9 @@ class ResidentEngine:
     def __init__(self, state: EngineState, faults: EngineFaults,
                  settings: Settings, *,
                  traffic: Optional[TrafficGenerator] = None,
+                 servo: Optional[LoadServo] = None,
+                 slo: Optional[SloWindows] = None,
+                 status: Optional[StatusPublisher] = None,
                  sink: Optional[str] = None, write_ticks: bool = True,
                  donate: bool = True, n_initial: Optional[int] = None):
         self.settings = settings
@@ -110,6 +116,12 @@ class ResidentEngine:
         self._rec = None
         self._faults = faults
         self.traffic = traffic
+        if servo is not None and traffic is None:
+            raise ValueError("a servo needs an attached traffic generator")
+        self.servo = servo
+        self.slo = slo
+        self._vc_fold = ViewChangeFold(0) if slo is not None else None
+        self.status = status
         self._inert_schedule = (churn_mod.empty_schedule(self.capacity)
                                 if traffic is not None else None)
         self._donate = donate
@@ -121,6 +133,8 @@ class ResidentEngine:
         self.chunks = 0
         self.ticks = 0
         self.checkpoint_block: Optional[dict] = None
+        self.compile_s: Optional[float] = None
+        self._dispatches = 0
         self._wall0 = time.perf_counter()
         self._last_drain_wall = self._wall0
         self._watermarks: list = []
@@ -135,6 +149,11 @@ class ResidentEngine:
     def _next_schedule(self):
         if self.traffic is None:
             return None, None
+        if self.servo is not None:
+            # The committed rate from the last drained heartbeat drives
+            # this whole chunk; closed-loop sampling keeps the rng
+            # stream advancement identical whatever the rate.
+            self.traffic.set_join_rate(self.servo.rate_per_ktick)
         schedule, tinfo = self.traffic.next_chunk(
             self.settings.stream_chunk_ticks)
         # Quiet windows reuse one inert schedule: same pytree structure,
@@ -149,16 +168,29 @@ class ResidentEngine:
 
     def _dispatch(self, *, donate: Optional[bool] = None) -> dict:
         schedule, tinfo = self._next_schedule()
+        applied_rate = (self.servo.rate_per_ktick
+                        if self.servo is not None else None)
+        t0 = time.perf_counter()
         out = simulate_chunk(
             self._state, self._faults, self.settings.stream_chunk_ticks,
             self.settings, churn=schedule, rec=self._rec,
             donate=self._donate if donate is None else donate)
+        dispatch_wall = time.perf_counter() - t0
+        # The first dispatch of this process blocks on trace + compile
+        # before the async enqueue returns; its wall is the compile cost
+        # the chunk-0 heartbeat reports separately (execution itself is
+        # async and lands in the drain wall).
+        compile_s = dispatch_wall if self._dispatches == 0 else None
+        self._dispatches += 1
+        if compile_s is not None:
+            self.compile_s = compile_s
         if self.settings.flight_recorder_window:
             self._state, logs, self._rec = out
         else:
             self._state, logs = out
         pending = {"index": self.chunks, "logs": logs, "tinfo": tinfo,
-                   "checkpoint": None}
+                   "checkpoint": None, "compile_s": compile_s,
+                   "servo_rate": applied_rate}
         self.chunks += 1
         self.ticks += self.settings.stream_chunk_ticks
         return pending
@@ -174,25 +206,78 @@ class ResidentEngine:
         now = time.perf_counter()
         wall = now - self._last_drain_wall
         self._last_drain_wall = now
+        compile_s = pending.get("compile_s")
+        if compile_s is not None:
+            # The drain wall of the first chunk folds the one-time
+            # trace/compile cost in; report it separately and exclude it
+            # from wall_s, so chunk-0 rates (and the servo's control
+            # input) measure execution throughput, not the compiler.
+            compile_s = min(compile_s, wall)
+            wall = wall - compile_s
         live = _live_buffer_bytes()
         self._watermarks.append(live)
         tinfo = pending["tinfo"]
+        backlog = ((tinfo["backlog_joins"] + tinfo["backlog_leaves"])
+                   if tinfo else None)
+        servo_block = None
+        if self.servo is not None:
+            self.servo.observe(ticks=self.settings.stream_chunk_ticks,
+                               wall_s=wall, backlog=backlog or 0)
+            servo_block = self.servo.chunk_block(pending["servo_rate"])
+        slo_block = None
+        if self.slo is not None:
+            slo_block = self.slo.fold_chunk(self._vc_fold.fold(rows))
         record = {
             "record": "chunk",
             "index": pending["index"],
             "tick": rows[-1].tick if rows else self.ticks,
             "ticks": self.settings.stream_chunk_ticks,
             "wall_s": wall,
+            "compile_s": compile_s,
             "ticks_per_sec": _rate(self.settings.stream_chunk_ticks, wall),
             "events_per_sec": _rate(tinfo["events"], wall) if tinfo else None,
             "announces": sum(r.announce for r in rows),
             "decides": sum(r.decide for r in rows),
             "live_buffer_bytes": live,
             "traffic": tinfo,
+            "servo": servo_block,
+            "slo": slo_block,
             "checkpoint": pending["checkpoint"],
         }
         self.chunk_records.append(record)
         self._emit(record)
+        if self.status is not None:
+            self.status.publish(self._status_snapshot(record, rows))
+
+    def _status_snapshot(self, record: dict, rows) -> dict:
+        """The chunk-boundary ``status_snapshot`` block (``telemetry
+        .schema.STATUS_SNAPSHOT_SPEC``) — built purely from
+        already-drained host data, so publishing can never perturb the
+        protocol stream."""
+        from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+        last = rows[-1] if rows else None
+        tinfo = record["traffic"]
+        backlog = ((tinfo["backlog_joins"] + tinfo["backlog_leaves"])
+                   if tinfo else None)
+        return {
+            "record": "status_snapshot",
+            "schema_version": SCHEMA_VERSION,
+            "source": "resident",
+            "tick": record["tick"],
+            "chunks": self.chunks,
+            "epoch": int(last.epoch) if last is not None else -1,
+            "n_members": (int(last.n_member)
+                          if last is not None else self.n_initial),
+            "ticks_per_sec": record["ticks_per_sec"],
+            "events_per_sec": record["events_per_sec"],
+            "backlog": backlog,
+            "live_buffer_bytes": record["live_buffer_bytes"],
+            "servo": record["servo"],
+            "slo": record["slo"],
+            "checkpoint": self.checkpoint_block,
+            "wall_s": time.perf_counter() - self._wall0,
+        }
 
     # --- public loop ------------------------------------------------------
 
@@ -218,6 +303,11 @@ class ResidentEngine:
                 "n_initial": self.n_initial}
         if self.traffic is not None:
             blob["traffic"] = self.traffic.state_dict()
+        if self.servo is not None:
+            blob["servo"] = self.servo.state_dict()
+        if self.slo is not None:
+            blob["slo"] = self.slo.state_dict()
+            blob["vc_fold"] = self._vc_fold.state_dict()
         return blob
 
     def save(self, path: str) -> dict:
@@ -241,8 +331,17 @@ class ResidentEngine:
         traffic = kw.pop("traffic", None)
         if traffic is None and "traffic" in host:
             traffic = TrafficGenerator.from_state(host["traffic"], settings)
+        servo = kw.pop("servo", None)
+        if servo is None and "servo" in host:
+            servo = LoadServo.from_state(host["servo"])
+        slo = kw.pop("slo", None)
+        if slo is None and "slo" in host:
+            slo = SloWindows.from_state(host["slo"])
         eng = cls(cp.parts["state"], faults, settings, traffic=traffic,
+                  servo=servo, slo=slo,
                   n_initial=host.get("n_initial"), **kw)
+        if eng.slo is not None and "vc_fold" in host:
+            eng._vc_fold = ViewChangeFold.from_state(host["vc_fold"])
         rec = cp.parts.get("recorder")
         # Own buffers before the first donated dispatch: the npz-backed
         # host arrays must not be handed to XLA as donations.
@@ -301,7 +400,9 @@ class ResidentEngine:
         self._state = _dealias(r_final)
         self._rec = _dealias(r_rec2) if r_rec2 is not None else None
         pending = {"index": self.chunks, "logs": r_logs, "tinfo": tinfo,
-                   "checkpoint": block}
+                   "checkpoint": block, "compile_s": None,
+                   "servo_rate": (self.servo.rate_per_ktick
+                                  if self.servo is not None else None)}
         self.chunks += 1
         self.ticks += n
         self._drain(pending)
@@ -337,10 +438,16 @@ class ResidentEngine:
             "announcements": s.announcements if s else 0,
             "decisions": s.decisions if s else 0,
             "wall_s": wall,
+            "compile_s": self.compile_s,
             "ticks_per_sec": _rate(self.ticks, wall),
             "events_per_sec": _rate(
                 self.traffic.events if self.traffic else 0, wall),
             "ticks_to_view_change": _dist(ttvc),
+            "servo": ({"config": self.servo.config.as_dict(),
+                       "final": self.servo.chunk_block(
+                           self.servo.rate_per_ktick)}
+                      if self.servo is not None else None),
+            "slo": self.slo.block() if self.slo is not None else None,
             # ``steady_max`` excludes verify-round-trip chunks, which
             # transiently hold both the live and the restored branch;
             # the flat-memory gate reads it.
@@ -363,11 +470,17 @@ class ResidentEngine:
         if self._sink is not None:
             self._sink.close()
             self._sink = None
+        if self.status is not None:
+            self.status.close()
+            self.status = None
 
 
 def boot_resident(settings: Settings, capacity: int, n_initial: int, *,
                   seed: int = 0,
                   traffic_config: Optional[TrafficConfig] = None,
+                  servo: Optional[LoadServo] = None,
+                  slo: Optional[SloWindows] = None,
+                  status: Optional[StatusPublisher] = None,
                   sink: Optional[str] = None, write_ticks: bool = True,
                   donate: bool = True) -> ResidentEngine:
     """Boot a converged ``n_initial``-member cluster with a dormant
@@ -385,5 +498,6 @@ def boot_resident(settings: Settings, capacity: int, n_initial: int, *,
                        id_fps=id_fps)
     faults = crash_faults([I32_MAX] * capacity)
     return ResidentEngine(state, faults, settings, traffic=traffic,
+                          servo=servo, slo=slo, status=status,
                           sink=sink, write_ticks=write_ticks, donate=donate,
                           n_initial=n_initial)

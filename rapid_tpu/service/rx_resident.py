@@ -1,0 +1,353 @@
+"""Receiver-resident driver: the per-receiver scan as a chunked service.
+
+``ResidentEngine`` made the shared-state engine a long-lived process;
+the per-receiver wire (``engine.receiver`` / ``engine.rx_packed``) had
+the chunk entry point (``receiver_simulate_chunk``) but nothing drove
+it as a service. This driver is the receiver twin, sharing the
+resident conventions file for file:
+
+- the stream runs as fixed-size chunks over the layout-preserving
+  carry — a dense ``ReceiverState`` under ``rx_kernel="xla"``, a
+  ``rx_packed.PackedReceiverBundle`` under the packed layouts (the
+  first dispatch converts via ``as_bundle``; every later chunk re-feeds
+  the bundle verbatim), so a C>=1024 soak holds exactly one packed
+  working set on device;
+- dispatch is double-buffered and carries are donated, identical to
+  ``ResidentEngine``; the chunk heartbeats are the same
+  ``record: "chunk"`` shape (``telemetry.schema.STREAM_CHUNK_SPEC``,
+  with ``traffic``/``servo`` null — there is no churn generator on the
+  receiver wire) and carry the same rolling ``slo`` block, folded
+  per-slot by ``telemetry.slo.ReceiverViewChangeFold`` (each live slot
+  runs its own protocol instance);
+- :meth:`verify_round_trip` checkpoints mid-soak through
+  ``service.checkpoint``'s ``receiver_dense``/``receiver_packed``
+  families (``save_receiver`` / ``restore_receiver_carry``) and proves
+  the restore exact the same two ways: bitwise-equal restored pytrees,
+  and byte-identical continuation logs/final/recorder from the live
+  and restored branches — then adopts the restored branch as the
+  continuing carry, so the committed soak artifact is itself evidence
+  that a packed save/restore loses nothing.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+from rapid_tpu.engine.receiver import receiver_simulate_chunk
+from rapid_tpu.faults import two_zone_schedule
+from rapid_tpu.service import checkpoint as checkpoint_mod
+from rapid_tpu.service.resident import (_dealias, _live_buffer_bytes,
+                                        _rate, _tree_equal)
+from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry import json_artifact_line
+from rapid_tpu.telemetry.metrics import _dist
+from rapid_tpu.telemetry.slo import ReceiverViewChangeFold, SloWindows
+
+
+class ResidentReceiver:
+    """One resident per-receiver member plus its I/O loop.
+
+    ``chunk_ticks`` is the receiver analogue of
+    ``Settings.stream_chunk_ticks`` (a static of the chunk executable):
+    per-receiver ticks at large C cost orders of magnitude more wall
+    than shared-state ticks, so the chunk size is a driver parameter
+    rather than a layout setting.
+    """
+
+    def __init__(self, carry, faults, settings: Settings, *,
+                 capacity: int, chunk_ticks: int,
+                 slo: Optional[SloWindows] = None,
+                 sink: Optional[str] = None, donate: bool = True):
+        if chunk_ticks < 1:
+            raise ValueError(f"chunk_ticks must be >= 1, got {chunk_ticks}")
+        self.settings = settings
+        self.capacity = int(capacity)
+        self.chunk_ticks = int(chunk_ticks)
+        self._carry = _dealias(carry)
+        self._faults = faults
+        self._rec = None
+        self.slo = slo
+        self._vc_fold = (ReceiverViewChangeFold(self.capacity)
+                         if slo is not None else None)
+        self._donate = donate
+        self._sink = open(sink, "w") if sink else None
+        self._pending = None
+        self.chunk_records: list = []
+        self.chunks = 0
+        self.ticks = 0
+        self.announces = 0
+        self.decides = 0
+        self._ttvc: list = []
+        self.checkpoint_block: Optional[dict] = None
+        self.compile_s: Optional[float] = None
+        self._dispatches = 0
+        self._wall0 = time.perf_counter()
+        self._last_drain_wall = self._wall0
+        self._watermarks: list = []
+
+    @property
+    def carry(self):
+        """The current carry (chunk-boundary accurate after ``flush``)."""
+        return self._carry
+
+    # --- internals --------------------------------------------------------
+
+    def _emit(self, record: dict) -> None:
+        if self._sink is not None:
+            self._sink.write(json_artifact_line(record, sort_keys=True))
+            self._sink.flush()
+
+    def _dispatch(self) -> dict:
+        t0 = time.perf_counter()
+        out = receiver_simulate_chunk(
+            self._carry, self._faults, self.chunk_ticks, self.settings,
+            rec=self._rec, donate=self._donate)
+        dispatch_wall = time.perf_counter() - t0
+        # Same chunk-0 convention as ResidentEngine._dispatch: the first
+        # dispatch blocks on trace + compile, so its wall is the compile
+        # cost the heartbeat splits out of the rates.
+        compile_s = dispatch_wall if self._dispatches == 0 else None
+        self._dispatches += 1
+        if compile_s is not None:
+            self.compile_s = compile_s
+        if self.settings.flight_recorder_window:
+            self._carry, logs, self._rec = out
+        else:
+            self._carry, logs = out
+        pending = {"index": self.chunks, "logs": logs,
+                   "checkpoint": None, "compile_s": compile_s}
+        self.chunks += 1
+        self.ticks += self.chunk_ticks
+        return pending
+
+    def _drain(self, pending: dict) -> None:
+        logs = pending["logs"]
+        jax.block_until_ready(logs)
+        ticks_col = np.asarray(logs.tick)
+        announce_tc = np.asarray(logs.announce, bool)
+        decide_tc = np.asarray(logs.decide, bool)
+        announces = int(announce_tc.sum())
+        decides = int(decide_tc.sum())
+        self.announces += announces
+        self.decides += decides
+        now = time.perf_counter()
+        wall = now - self._last_drain_wall
+        self._last_drain_wall = now
+        compile_s = pending.get("compile_s")
+        if compile_s is not None:
+            compile_s = min(compile_s, wall)
+            wall = wall - compile_s
+        live = _live_buffer_bytes()
+        self._watermarks.append(live)
+        slo_block = None
+        if self.slo is not None:
+            samples = self._vc_fold.fold(ticks_col, announce_tc, decide_tc)
+            self._ttvc.extend(samples["ticks_to_view_change"])
+            slo_block = self.slo.fold_chunk(samples)
+        record = {
+            "record": "chunk",
+            "index": pending["index"],
+            "tick": (int(ticks_col[-1]) if ticks_col.size else self.ticks),
+            "ticks": self.chunk_ticks,
+            "wall_s": wall,
+            "compile_s": compile_s,
+            "ticks_per_sec": _rate(self.chunk_ticks, wall),
+            "events_per_sec": None,
+            "announces": announces,
+            "decides": decides,
+            "live_buffer_bytes": live,
+            "traffic": None,
+            "servo": None,
+            "slo": slo_block,
+            "checkpoint": pending["checkpoint"],
+        }
+        self.chunk_records.append(record)
+        self._emit(record)
+
+    # --- public loop ------------------------------------------------------
+
+    def flush(self) -> None:
+        """Drain the in-flight chunk, if any."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            self._drain(pending)
+
+    def run(self, n_chunks: int) -> None:
+        """Run ``n_chunks`` chunks, double-buffered."""
+        for _ in range(int(n_chunks)):
+            dispatched = self._dispatch()
+            self.flush()
+            self._pending = dispatched
+        self.flush()
+
+    # --- checkpoint/restore ----------------------------------------------
+
+    def _host_blob(self) -> dict:
+        blob = {"chunks": self.chunks, "ticks": self.ticks,
+                "capacity": self.capacity,
+                "chunk_ticks": self.chunk_ticks,
+                "announces": self.announces, "decides": self.decides}
+        if self.slo is not None:
+            blob["slo"] = self.slo.state_dict()
+            blob["vc_fold"] = self._vc_fold.state_dict()
+        return blob
+
+    def save(self, path: str) -> dict:
+        """Checkpoint the receiver carry in whichever layout it runs
+        (``receiver_dense`` or ``receiver_packed`` family) — drains the
+        in-flight chunk first so the saved carry is a chunk boundary."""
+        self.flush()
+        return checkpoint_mod.save_receiver(
+            path, self._carry, self.settings, tick=self.ticks,
+            rec=self._rec, host=self._host_blob())
+
+    @classmethod
+    def restore(cls, path: str, faults, settings: Settings,
+                **kw) -> "ResidentReceiver":
+        cp = checkpoint_mod.load_checkpoint(path, settings)
+        carry = checkpoint_mod.restore_receiver_carry(cp, settings)
+        host = cp.host or {}
+        slo = kw.pop("slo", None)
+        if slo is None and "slo" in host:
+            slo = SloWindows.from_state(host["slo"])
+        rx = cls(carry, faults, settings,
+                 capacity=int(host["capacity"]),
+                 chunk_ticks=kw.pop("chunk_ticks",
+                                    int(host["chunk_ticks"])),
+                 slo=slo, **kw)
+        if rx.slo is not None and "vc_fold" in host:
+            rx._vc_fold = ReceiverViewChangeFold.from_state(host["vc_fold"])
+        rec = cp.parts.get("recorder")
+        rx._rec = _dealias(rec) if rec is not None else None
+        rx.chunks = int(host.get("chunks", 0))
+        rx.ticks = int(host.get("ticks", cp.tick))
+        rx.announces = int(host.get("announces", 0))
+        rx.decides = int(host.get("decides", 0))
+        return rx
+
+    def verify_round_trip(self, path: str) -> dict:
+        """Save, restore, and prove the restore exact (the receiver twin
+        of ``ResidentEngine.verify_round_trip``); returns the
+        ``checkpoint`` block the summary embeds, and adopts the restored
+        branch as the continuing carry."""
+        self.flush()
+        self.save(path)
+        cp = checkpoint_mod.load_checkpoint(path, self.settings)
+        restored = checkpoint_mod.restore_receiver_carry(cp, self.settings)
+        r_rec = cp.parts.get("recorder")
+        state_identical = _tree_equal(self._carry, restored)
+        recorder_identical = (_tree_equal(self._rec, r_rec)
+                              if self._rec is not None else None)
+
+        n = self.chunk_ticks
+        live = receiver_simulate_chunk(self._carry, self._faults, n,
+                                       self.settings, rec=self._rec,
+                                       donate=False)
+        rest = receiver_simulate_chunk(restored, self._faults, n,
+                                       self.settings, rec=r_rec,
+                                       donate=False)
+        if self.settings.flight_recorder_window:
+            l_final, l_logs, l_rec = live
+            r_final, r_logs, r_rec2 = rest
+            cont_rec_ok = _tree_equal(l_rec, r_rec2)
+        else:
+            l_final, l_logs = live
+            r_final, r_logs = rest
+            r_rec2 = None
+            cont_rec_ok = None
+        block = {
+            "version": checkpoint_mod.CHECKPOINT_VERSION,
+            "tick": cp.tick,
+            "state_identical": bool(state_identical),
+            "recorder_identical": recorder_identical,
+            "logs_identical": bool(_tree_equal(l_logs, r_logs)),
+            "final_identical": bool(_tree_equal(l_final, r_final)),
+            "continuation_recorder_identical": cont_rec_ok,
+        }
+        self._carry = _dealias(r_final)
+        self._rec = _dealias(r_rec2) if r_rec2 is not None else None
+        pending = {"index": self.chunks, "logs": r_logs,
+                   "checkpoint": block, "compile_s": None}
+        self.chunks += 1
+        self.ticks += n
+        self._drain(pending)
+        self.checkpoint_block = block
+        return block
+
+    # --- summary ----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The final ``record: "stream_summary"`` line
+        (``source: "resident_receiver"``, traffic/servo null)."""
+        from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+        self.flush()
+        wall = time.perf_counter() - self._wall0
+        marks = self._watermarks
+        record = {
+            "record": "stream_summary",
+            "schema_version": SCHEMA_VERSION,
+            "source": "resident_receiver",
+            "n": self.capacity,
+            "capacity": self.capacity,
+            "ticks": self.ticks,
+            "chunks": self.chunks,
+            "chunk_ticks": self.chunk_ticks,
+            "events_injected": 0,
+            "joins": 0,
+            "leaves": 0,
+            "bursts": 0,
+            "announcements": self.announces,
+            "decisions": self.decides,
+            "wall_s": wall,
+            "compile_s": self.compile_s,
+            "ticks_per_sec": _rate(self.ticks, wall),
+            "events_per_sec": None,
+            "ticks_to_view_change": _dist(self._ttvc),
+            "servo": None,
+            "slo": self.slo.block() if self.slo is not None else None,
+            "live_buffer_bytes": {
+                "first": marks[0] if marks else None,
+                "max": max(marks) if marks else None,
+                "steady_max": max(
+                    (r["live_buffer_bytes"] for r in self.chunk_records
+                     if not r["checkpoint"]), default=None),
+                "last": marks[-1] if marks else None,
+            },
+            "traffic": None,
+            "checkpoint": self.checkpoint_block,
+        }
+        self._emit(record)
+        return record
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+
+def boot_resident_receiver(settings: Settings, n: int, *, seed: int = 0,
+                           horizon_ticks: int, chunk_ticks: int,
+                           slo: Optional[SloWindows] = None,
+                           sink: Optional[str] = None,
+                           donate: bool = True) -> ResidentReceiver:
+    """Boot the named two-zone deployment as a resident receiver member:
+    ``faults.two_zone_schedule`` lowered through
+    ``fleet.lower_receiver_schedule``, carry handed to the driver in
+    whatever layout ``settings.rx_kernel`` selects. ``horizon_ticks``
+    bounds the fault schedule, not the run — chunks past the horizon
+    tick on with the faults gone inert."""
+    from rapid_tpu.engine.fleet import lower_receiver_schedule
+
+    sched = two_zone_schedule(n, seed, int(horizon_ticks),
+                              ring_depth=settings.delivery_ring_depth)
+    member = lower_receiver_schedule(sched, settings)
+    # member.state is already in the layout rx_kernel selects: a dense
+    # ReceiverState under "xla", a PackedReceiverBundle otherwise.
+    return ResidentReceiver(member.state, member.faults, settings,
+                            capacity=n, chunk_ticks=chunk_ticks, slo=slo,
+                            sink=sink, donate=donate)

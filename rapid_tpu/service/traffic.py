@@ -88,6 +88,14 @@ class TrafficConfig:
     #: delay depends only on when the slot left, never on where a chunk
     #: boundary fell, so recycling preserves chunk-split invariance.
     reuse_slots: bool = True
+    #: Closed-loop sampling (``service.servo``): joins draw exactly one
+    #: uniform per tick and invert the Poisson CDF, so the rng stream
+    #: advances identically whatever the current rate — the servo may
+    #: retarget ``set_join_rate`` between chunks without perturbing the
+    #: seeded stream, and a recorded rate trace replays byte-exactly.
+    #: False keeps the historical ``rng.poisson`` draw (whose stream
+    #: consumption is rate-dependent) and rejects ``set_join_rate``.
+    closed_loop: bool = False
 
     def __post_init__(self) -> None:
         if self.join_rate_per_ktick < 0 or self.leave_burst_rate_per_ktick < 0:
@@ -103,6 +111,25 @@ class TrafficConfig:
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+def _poisson_inverse(u: float, lam: float) -> int:
+    """Poisson sample by CDF inversion from one uniform draw —
+    deterministic in ``(u, lam)`` and rate-independent in rng
+    consumption. Per-tick lambdas here are O(1) (at most
+    ``max_rate_per_ktick / 1000`` per tick times the diurnal swing), so
+    the walk terminates in a handful of steps; the hard cap guards a
+    pathological hand-built config."""
+    if lam <= 0.0:
+        return 0
+    p = math.exp(-lam)
+    cum = p
+    k = 0
+    while u > cum and k < 4096:
+        k += 1
+        p *= lam / k
+        cum += p
+    return k
 
 
 class TrafficGenerator:
@@ -145,6 +172,9 @@ class TrafficGenerator:
         self._recycle = max(self._spacing,
                             int(settings.stream_chunk_ticks))
         self._rng = np.random.Generator(np.random.PCG64(config.seed))
+        # The live join rate: config.join_rate_per_ktick until a servo
+        # retargets it (closed_loop only — see set_join_rate).
+        self._rate_per_ktick = float(config.join_rate_per_ktick)
         self._members = sorted(range(n_initial))
         # FIFO of [slot, eligible_tick]; the boot pool is eligible
         # immediately.
@@ -180,8 +210,23 @@ class TrafficGenerator:
 
     # --- the arrival process ---------------------------------------------
 
+    def set_join_rate(self, rate_per_ktick: float) -> None:
+        """Retarget the join rate (events per kilotick) — the servo's
+        actuator. Only legal on closed-loop generators, where the rng
+        advancement is rate-independent; changing the Poisson lambda of
+        the open-loop ``rng.poisson`` draw would silently shift the
+        seeded stream."""
+        if not self.config.closed_loop:
+            raise ValueError(
+                "set_join_rate requires TrafficConfig.closed_loop=True "
+                "(open-loop rng advancement is rate-dependent)")
+        if rate_per_ktick < 0:
+            raise ValueError(
+                f"rate_per_ktick must be >= 0, got {rate_per_ktick}")
+        self._rate_per_ktick = float(rate_per_ktick)
+
     def _join_rate(self, t: int) -> float:
-        base = self.config.join_rate_per_ktick / 1000.0
+        base = self._rate_per_ktick / 1000.0
         amp = self.config.diurnal_amplitude
         if amp == 0.0:
             return base
@@ -248,8 +293,14 @@ class TrafficGenerator:
         leave_per_tick = self.config.leave_burst_rate_per_ktick / 1000.0
         chunk_bursts: list = []
         t0 = self._tick
+        closed = self.config.closed_loop
         for t in range(t0 + 1, t0 + int(n_ticks) + 1):
-            self._pending_joins += int(self._rng.poisson(self._join_rate(t)))
+            if closed:
+                self._pending_joins += _poisson_inverse(
+                    self._rng.random(), self._join_rate(t))
+            else:
+                self._pending_joins += int(
+                    self._rng.poisson(self._join_rate(t)))
             if self._rng.random() < leave_per_tick:
                 self._pending_leaves += self.config.leave_burst_size
             if t < self._next_enqueue:
@@ -332,6 +383,7 @@ class TrafficGenerator:
                     "inc": int(rng_state["state"]["inc"]),
                     "has_uint32": int(rng_state["has_uint32"]),
                     "uinteger": int(rng_state["uinteger"])},
+            "rate_per_ktick": self._rate_per_ktick,
             "members": list(self._members),
             "free": [[int(s), int(e)] for s, e in self._free],
             "epoch": self._epoch,
@@ -357,6 +409,8 @@ class TrafficGenerator:
             "has_uint32": state["rng"]["has_uint32"],
             "uinteger": state["rng"]["uinteger"],
         }
+        gen._rate_per_ktick = float(
+            state.get("rate_per_ktick", config.join_rate_per_ktick))
         gen._members = list(state["members"])
         gen._free = [[int(s), int(e)] for s, e in state["free"]]
         gen._epoch = int(state["epoch"])

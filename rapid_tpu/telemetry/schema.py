@@ -25,7 +25,7 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 #: Fold semantics of every RunSummary gauge when aggregated over a fleet
 #: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
@@ -478,6 +478,9 @@ TRAFFIC_CONFIG_SPEC = {
     "max_join_burst": (int,),
     "min_members": (int,),
     "reuse_slots": (bool,),
+    # Schema v10: closed-loop sampling (one uniform per tick, Poisson by
+    # CDF inversion) — the mode the load servo requires.
+    "closed_loop": (bool,),
 }
 
 #: Per-chunk traffic lowering counts (``TrafficGenerator.next_chunk``
@@ -516,12 +519,19 @@ STREAM_CHUNK_SPEC = {
     "tick": (int,),
     "ticks": (int,),
     "wall_s": _NUM,
+    # Schema v10: chunk 0 splits the one-time trace+compile wall out of
+    # ``wall_s`` (null on every later chunk), so heartbeat rates — and
+    # the servo's control input — measure execution, not the compiler.
+    "compile_s": (int, float, type(None)),
     "ticks_per_sec": (int, float, type(None)),
     "events_per_sec": (int, float, type(None)),
     "announces": (int,),
     "decides": (int,),
     "live_buffer_bytes": (int,),
     "traffic": (dict, type(None)),
+    # Schema v10: null unless a LoadServo / SloWindows is attached.
+    "servo": (dict, type(None)),
+    "slo": (dict, type(None)),
     "checkpoint": (dict, type(None)),
 }
 
@@ -554,12 +564,131 @@ STREAM_SUMMARY_SPEC = {
     "announcements": (int,),
     "decisions": (int,),
     "wall_s": _NUM,
+    "compile_s": (int, float, type(None)),
     "ticks_per_sec": (int, float, type(None)),
     "events_per_sec": (int, float, type(None)),
     "ticks_to_view_change": (dict,),
     "live_buffer_bytes": (dict,),
     "traffic": (dict, type(None)),
+    # Schema v10: the final servo state ({"config", "final"}) and the
+    # final rolling SLO window; null when not attached.
+    "servo": (dict, type(None)),
+    "slo": (dict, type(None)),
     "checkpoint": (dict, type(None)),
+}
+
+# --- streaming observatory records (schema v10) ---------------------------
+
+#: ``service.servo.ServoConfig.as_dict()`` — the control-law constants
+#: a committed sweep is exactly reproducible from.
+SERVO_CONFIG_SPEC = {
+    "target_events_per_sec": _NUM,
+    "initial_ticks_per_sec": _NUM,
+    "pinned_ticks_per_sec": (int, float, type(None)),
+    "gain": _NUM,
+    "rate_quantum_per_ktick": _NUM,
+    "min_rate_per_ktick": _NUM,
+    "max_rate_per_ktick": _NUM,
+    "tps_quantum": _NUM,
+}
+
+#: The per-chunk ``servo`` heartbeat block
+#: (``LoadServo.chunk_block``): ``rate_per_ktick`` is the quantized
+#: rate the chunk actually ran at, ``backlog`` the generator's
+#: offered-minus-applied saturation observable.
+SERVO_CHUNK_SPEC = {
+    "target_events_per_sec": _NUM,
+    "rate_per_ktick": _NUM,
+    "ticks_per_sec_estimate": _NUM,
+    "backlog": (int,),
+    "updates": (int,),
+}
+
+#: The metric names every ``slo`` block carries
+#: (``telemetry.slo.SLO_METRICS``, duplicated here so this module stays
+#: dependency-free).
+SLO_METRIC_NAMES = ("decide_latency", "ticks_to_view_change")
+
+#: One windowed metric: bucket counts over the window plus nearest-rank
+#: percentiles as bucket upper edges (null when the window is empty).
+SLO_METRIC_SPEC = {
+    "count": (int,),
+    "counts": (list,),
+    "p50": _OPT_INT,
+    "p95": _OPT_INT,
+    "p99": _OPT_INT,
+}
+
+#: The rolling ``slo`` heartbeat block (``telemetry.slo.SloWindows``).
+SLO_WINDOW_SPEC = {
+    "window_chunks": (int,),
+    "chunks": (int,),
+    "bucket_edges": (list,),
+    "metrics": (dict,),
+}
+
+#: One ``record: "status_snapshot"`` line of the live status API
+#: (``service.status``) — the latest chunk-boundary picture, built
+#: purely from already-drained host data.
+STATUS_SNAPSHOT_SPEC = {
+    "record": (str,),
+    "schema_version": (int,),
+    "source": (str,),
+    "tick": (int,),
+    "chunks": (int,),
+    "epoch": (int,),
+    "n_members": (int,),
+    "ticks_per_sec": (int, float, type(None)),
+    "events_per_sec": (int, float, type(None)),
+    "backlog": (int, type(None)),
+    "live_buffer_bytes": (int,),
+    "servo": (dict, type(None)),
+    "slo": (dict, type(None)),
+    "checkpoint": (dict, type(None)),
+    "wall_s": _NUM,
+}
+
+#: One target of a ``record: "load_sweep"`` saturation sweep: the servo
+#: config it ran under, what it achieved, and the stability verdict
+#: (bounded backlog slope over the measured chunks).
+LOAD_SWEEP_RATE_SPEC = {
+    "target_events_per_sec": _NUM,
+    "achieved_events_per_sec": (int, float, type(None)),
+    "rate_per_ktick": _NUM,
+    "ticks_per_sec": (int, float, type(None)),
+    "chunks": (int,),
+    "events": (int,),
+    "backlog_final": (int,),
+    "backlog_slope_per_chunk": _NUM,
+    "stable": (bool,),
+    "servo_config": (dict,),
+    "slo": (dict,),
+}
+
+#: The measured knee: the largest stable target (null when every
+#: target was unstable), with its achieved rate and windowed tail.
+LOAD_SWEEP_KNEE_SPEC = {
+    "target_events_per_sec": (int, float, type(None)),
+    "achieved_events_per_sec": (int, float, type(None)),
+    "ticks_to_view_change_p99": _OPT_INT,
+}
+
+#: The ``record: "load_sweep"`` artifact (``benchmarks/load_sweep.json``,
+#: ``python -m rapid_tpu.service --load-sweep``).
+LOAD_SWEEP_SPEC = {
+    "record": (str,),
+    "schema_version": (int,),
+    "n": (int,),
+    "capacity": (int,),
+    "chunk_ticks": (int,),
+    "chunks_per_rate": (int,),
+    "warmup_chunks": (int,),
+    "seed": (int,),
+    "backlog_slope_threshold": _NUM,
+    "targets": (list,),
+    "rates": (list,),
+    "knee": (dict, type(None)),
+    "wall_s": _NUM,
 }
 
 #: ``service.checkpoint`` manifest (``manifest.json`` inside a
@@ -855,6 +984,55 @@ def validate_progress_stream(lines, where: str = "progress") -> List[str]:
     return errors
 
 
+def validate_slo_window(block, where: str = "slo") -> List[str]:
+    """Validate one rolling ``slo`` window block (schema v10): both
+    metrics present, each count vector exactly one bucket per edge and
+    summing to its ``count``."""
+    errors = _check(block, SLO_WINDOW_SPEC, where)
+    if not isinstance(block, dict):
+        return errors
+    edges = block.get("bucket_edges")
+    n_edges = len(edges) if isinstance(edges, list) else None
+    metrics = block.get("metrics")
+    if not isinstance(metrics, dict):
+        return errors
+    for name in SLO_METRIC_NAMES:
+        if name not in metrics:
+            errors.append(f"{where}.metrics.{name}: missing")
+    for name, metric in metrics.items():
+        mw = f"{where}.metrics.{name}"
+        if name not in SLO_METRIC_NAMES:
+            errors.append(f"{mw}: unknown metric (expected one of "
+                          f"{'/'.join(SLO_METRIC_NAMES)})")
+        errors += _check(metric, SLO_METRIC_SPEC, mw)
+        if not isinstance(metric, dict):
+            continue
+        counts = metric.get("counts")
+        if isinstance(counts, list):
+            if n_edges is not None and len(counts) != n_edges:
+                errors.append(f"{mw}.counts: {len(counts)} buckets for "
+                              f"{n_edges} edges")
+            total = sum(c for c in counts
+                        if isinstance(c, int) and not isinstance(c, bool))
+            if isinstance(metric.get("count"), int) \
+                    and metric["count"] != total:
+                errors.append(f"{mw}.count: {metric['count']} != "
+                              f"sum(counts) = {total}")
+    return errors
+
+
+def validate_servo_summary(block, where: str = "servo") -> List[str]:
+    """Validate a summary ``servo`` block ({"config", "final"})."""
+    errors: List[str] = []
+    if not isinstance(block, dict):
+        return [f"{where}: expected an object, got {type(block).__name__}"]
+    errors += _check(block.get("config"), SERVO_CONFIG_SPEC,
+                     f"{where}.config")
+    errors += _check(block.get("final"), SERVO_CHUNK_SPEC,
+                     f"{where}.final")
+    return errors
+
+
 def validate_stream_chunk(rec, where: str = "chunk") -> List[str]:
     """Validate one ``record: "chunk"`` resident heartbeat."""
     errors = _check(rec, STREAM_CHUNK_SPEC, where)
@@ -863,6 +1041,10 @@ def validate_stream_chunk(rec, where: str = "chunk") -> List[str]:
     if isinstance(rec.get("traffic"), dict):
         errors += _check(rec["traffic"], STREAM_TRAFFIC_INFO_SPEC,
                          f"{where}.traffic")
+    if isinstance(rec.get("servo"), dict):
+        errors += _check(rec["servo"], SERVO_CHUNK_SPEC, f"{where}.servo")
+    if isinstance(rec.get("slo"), dict):
+        errors += validate_slo_window(rec["slo"], f"{where}.slo")
     if isinstance(rec.get("checkpoint"), dict):
         errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
                          f"{where}.checkpoint")
@@ -885,9 +1067,88 @@ def validate_stream_summary(rec, where: str = "stream_summary"
     if isinstance(rec.get("traffic"), dict):
         errors += _check(rec["traffic"], TRAFFIC_CONFIG_SPEC,
                          f"{where}.traffic")
+    if isinstance(rec.get("servo"), dict):
+        errors += validate_servo_summary(rec["servo"], f"{where}.servo")
+    if isinstance(rec.get("slo"), dict):
+        errors += validate_slo_window(rec["slo"], f"{where}.slo")
     if isinstance(rec.get("checkpoint"), dict):
         errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
                          f"{where}.checkpoint")
+    return errors
+
+
+def validate_status_snapshot(rec, where: str = "status") -> List[str]:
+    """Validate one live ``record: "status_snapshot"`` line (schema
+    v10) — the status file's content and every socket reply."""
+    errors = _check(rec, STATUS_SNAPSHOT_SPEC, where)
+    if not isinstance(rec, dict):
+        return errors
+    errors += _version_errors(rec)
+    if rec.get("record") != "status_snapshot":
+        errors.append(f"{where}.record: expected 'status_snapshot', "
+                      f"got {rec.get('record')!r}")
+    if isinstance(rec.get("servo"), dict):
+        errors += _check(rec["servo"], SERVO_CHUNK_SPEC, f"{where}.servo")
+    if isinstance(rec.get("slo"), dict):
+        errors += validate_slo_window(rec["slo"], f"{where}.slo")
+    if isinstance(rec.get("checkpoint"), dict):
+        errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
+                         f"{where}.checkpoint")
+    return errors
+
+
+def validate_load_sweep(payload, where: str = "load_sweep") -> List[str]:
+    """Validate a ``record: "load_sweep"`` saturation-sweep artifact:
+    one rate entry per target (in target order), each with a schema-
+    valid servo config and SLO window, and a knee consistent with the
+    stability verdicts (the largest stable target, or null)."""
+    errors = _check(payload, LOAD_SWEEP_SPEC, where)
+    if not isinstance(payload, dict):
+        return errors
+    errors += _version_errors(payload)
+    if payload.get("record") != "load_sweep":
+        errors.append(f"{where}.record: expected 'load_sweep', "
+                      f"got {payload.get('record')!r}")
+    targets = payload.get("targets")
+    rates = payload.get("rates")
+    if isinstance(targets, list) and isinstance(rates, list) \
+            and len(targets) != len(rates):
+        errors.append(f"{where}.rates: {len(rates)} entries for "
+                      f"{len(targets)} targets")
+    best_stable = None
+    if isinstance(rates, list):
+        for i, rate in enumerate(rates):
+            rw = f"{where}.rates[{i}]"
+            errors += _check(rate, LOAD_SWEEP_RATE_SPEC, rw)
+            if not isinstance(rate, dict):
+                continue
+            if isinstance(targets, list) and i < len(targets) \
+                    and rate.get("target_events_per_sec") != targets[i]:
+                errors.append(
+                    f"{rw}.target_events_per_sec: expected "
+                    f"{targets[i]!r}, got "
+                    f"{rate.get('target_events_per_sec')!r}")
+            if isinstance(rate.get("servo_config"), dict):
+                errors += _check(rate["servo_config"], SERVO_CONFIG_SPEC,
+                                 f"{rw}.servo_config")
+            if isinstance(rate.get("slo"), dict):
+                errors += validate_slo_window(rate["slo"], f"{rw}.slo")
+            if rate.get("stable") is True and isinstance(
+                    rate.get("target_events_per_sec"), (int, float)):
+                t = rate["target_events_per_sec"]
+                if best_stable is None or t > best_stable:
+                    best_stable = t
+    knee = payload.get("knee")
+    if isinstance(knee, dict):
+        errors += _check(knee, LOAD_SWEEP_KNEE_SPEC, f"{where}.knee")
+        if knee.get("target_events_per_sec") != best_stable:
+            errors.append(
+                f"{where}.knee.target_events_per_sec: expected the "
+                f"largest stable target ({best_stable!r}), got "
+                f"{knee.get('target_events_per_sec')!r}")
+    elif knee is None and best_stable is not None:
+        errors.append(f"{where}.knee: null despite stable targets "
+                      f"(largest: {best_stable!r})")
     return errors
 
 
@@ -1124,9 +1385,30 @@ def main(argv=None) -> int:
             return 1
         print(f"streaming schema ok: {argv[1]}")
         return 0
+    if len(argv) == 2 and argv[0] in ("--load-sweep", "--status"):
+        with open(argv[1], "rb") as fh:
+            raw = fh.read()
+        errors = [] if raw.endswith(b"\n") else \
+            ["payload: file must end with a trailing newline"]
+        try:
+            payload = json.loads(raw)
+        except ValueError as e:
+            errors.append(f"payload: not JSON ({e})")
+            payload = None
+        if payload is not None:
+            validate = (validate_load_sweep if argv[0] == "--load-sweep"
+                        else validate_status_snapshot)
+            errors += validate(payload)
+        if errors:
+            for e in errors:
+                print(f"schema violation: {e}", file=sys.stderr)
+            return 1
+        print(f"{argv[0][2:]} schema ok: {argv[1]}")
+        return 0
     if len(argv) != 1:
         print("usage: python -m rapid_tpu.telemetry.schema "
-              "[--progress|--streaming] FILE", file=sys.stderr)
+              "[--progress|--streaming|--load-sweep|--status] FILE",
+              file=sys.stderr)
         return 2
     with open(argv[0], "rb") as fh:
         raw = fh.read()

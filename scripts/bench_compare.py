@@ -43,7 +43,19 @@ from typing import Dict, List, Tuple
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from rapid_tpu.telemetry.schema import validate_bench_payload  # noqa: E402
+from rapid_tpu.telemetry.schema import (validate_bench_payload,  # noqa: E402
+                                        validate_load_sweep)
+
+#: Seed-deterministic config of a ``record: "load_sweep"`` artifact —
+#: exact-gated, like every other config block. The measured figures
+#: (achieved rates, backlog slopes, stability verdicts, the knee) are
+#: wall-clock-dependent and warn-only: the committed sweep documents
+#: *this machine's* knee, not a protocol invariant.
+LOAD_SWEEP_CONFIG_KEYS = (
+    "record", "schema_version", "n", "capacity", "chunk_ticks",
+    "chunks_per_rate", "warmup_chunks", "seed",
+    "backlog_slope_threshold", "targets",
+)
 
 #: Run-config keys that must match for the count comparison to mean
 #: anything; a mismatch is an error telling the caller to regenerate.
@@ -296,6 +308,58 @@ def compare_profile_sweeps(current: Dict, baseline: Dict,
     return errors, warnings
 
 
+def compare_load_sweep(current: Dict, baseline: Dict,
+                       tps_tolerance: float
+                       ) -> Tuple[List[str], List[str]]:
+    """Diff two ``record: "load_sweep"`` artifacts: sweep config and
+    each rate's servo constants are exact; achieved throughput, the
+    stability verdicts, and the knee itself are machine-dependent and
+    only warn."""
+    errors: List[str] = []
+    warnings: List[str] = []
+    for key in LOAD_SWEEP_CONFIG_KEYS:
+        if current.get(key) != baseline.get(key):
+            errors.append(
+                f"payload.{key}: config mismatch (current "
+                f"{current.get(key)!r} vs baseline {baseline.get(key)!r}) "
+                f"— regenerate the baseline with --update-baseline")
+    if errors:
+        return errors, warnings  # rate rows are meaningless across configs
+
+    cur_rates = current.get("rates") or []
+    base_rates = baseline.get("rates") or []
+    if len(cur_rates) != len(base_rates):
+        errors.append(f"payload.rates: {len(cur_rates)} entries != "
+                      f"baseline {len(base_rates)}")
+    for i, (cur_r, base_r) in enumerate(zip(cur_rates, base_rates)):
+        where = f"payload.rates[{i}]"
+        for key in ("target_events_per_sec", "servo_config", "chunks"):
+            if cur_r.get(key) != base_r.get(key):
+                errors.append(f"{where}.{key}: {cur_r.get(key)!r} != "
+                              f"baseline {base_r.get(key)!r}")
+        if cur_r.get("stable") != base_r.get("stable"):
+            warnings.append(
+                f"{where}.stable: verdict flipped ({base_r.get('stable')} "
+                f"-> {cur_r.get('stable')}) — the knee moved on this "
+                f"machine")
+        cur_a, base_a = (cur_r.get("achieved_events_per_sec"),
+                         base_r.get("achieved_events_per_sec"))
+        if isinstance(cur_a, (int, float)) and \
+                isinstance(base_a, (int, float)) and base_a > 0 and \
+                cur_a < base_a * (1.0 - tps_tolerance):
+            drop = 100.0 * (1.0 - cur_a / base_a)
+            warnings.append(
+                f"{where}.achieved_events_per_sec: {cur_a} is "
+                f"{drop:.0f}% below baseline {base_a} (tolerance "
+                f"{tps_tolerance * 100:.0f}%)")
+    cur_knee = (current.get("knee") or {}).get("target_events_per_sec")
+    base_knee = (baseline.get("knee") or {}).get("target_events_per_sec")
+    if cur_knee != base_knee:
+        warnings.append(f"payload.knee.target_events_per_sec: {cur_knee!r}"
+                        f" != baseline {base_knee!r} (machine-dependent)")
+    return errors, warnings
+
+
 def compare_payloads(current: Dict, baseline: Dict,
                      tps_tolerance: float,
                      wall_tolerance: float = 0.50,
@@ -353,12 +417,17 @@ def main(argv=None) -> int:
 
     with open(args.current) as fh:
         current = json.load(fh)
+    is_sweep = current.get("record") == "load_sweep"
     if args.baseline is None:
-        name = ("dominance_report.json"
-                if current.get("bench") == "kernel_profile_sweep"
-                else "baseline.json")
+        if is_sweep:
+            name = "load_sweep.json"
+        elif current.get("bench") == "kernel_profile_sweep":
+            name = "dominance_report.json"
+        else:
+            name = "baseline.json"
         args.baseline = os.path.join(_REPO, "benchmarks", name)
-    schema_errors = validate_bench_payload(current)
+    validate = validate_load_sweep if is_sweep else validate_bench_payload
+    schema_errors = validate(current)
     if schema_errors:
         for e in schema_errors:
             print(f"bench_compare: current payload schema violation: {e}",
@@ -378,17 +447,21 @@ def main(argv=None) -> int:
         return 1
     with open(args.baseline) as fh:
         baseline = json.load(fh)
-    schema_errors = validate_bench_payload(baseline)
+    schema_errors = validate(baseline)
     if schema_errors:
         for e in schema_errors:
             print(f"bench_compare: baseline schema violation: {e}",
                   file=sys.stderr)
         return 1
 
-    errors, warnings = compare_payloads(current, baseline,
-                                        args.tps_tolerance,
-                                        args.wall_tolerance,
-                                        args.cps_tolerance)
+    if is_sweep:
+        errors, warnings = compare_load_sweep(current, baseline,
+                                              args.tps_tolerance)
+    else:
+        errors, warnings = compare_payloads(current, baseline,
+                                            args.tps_tolerance,
+                                            args.wall_tolerance,
+                                            args.cps_tolerance)
     for w in warnings:
         print(f"bench_compare: WARNING: {w}", file=sys.stderr)
     if errors:

@@ -19,6 +19,14 @@ the tail's last line, and a soak round whose tail does *not* end in
 that record is flagged as having lost its final heartbeat — the soak
 died between its last chunk and the summary flush.
 
+``LOADSWEEP_rNN.json`` records (capturing ``python -m
+rapid_tpu.service --load-sweep``) follow the same contract with a
+``load_sweep`` payload on the tail's last line: a round whose tail
+ends in anything else *lost its final block* (the sweep died between
+its last rate and the payload flush). Healthy sweeps contribute the
+knee columns — the largest stable target in events/sec and the
+windowed p99 ticks-to-view-change measured at that knee.
+
 Dead records are the whole point: a round whose ``tail`` is empty or
 whose ``parsed`` is null means the bench ran but its output was lost —
 historically a wall-budget kill with nothing flushed (``bench.py`` now
@@ -218,6 +226,75 @@ def _fold_soak(path: str) -> Dict[str, object]:
     return row
 
 
+def _fold_loadsweep(path: str) -> Dict[str, object]:
+    """One LOADSWEEP_rNN.json capture record -> a trend row.
+
+    Sweep captures mirror the soak ones (``{n, rc, tail}``) but the
+    last stdout line must be the sweep's final ``load_sweep`` payload.
+    A round whose tail ends in anything else *lost its final block* —
+    the sweep died (or was killed) between its last rate and the
+    payload flush — and is flagged exactly like a lost heartbeat. Note
+    a sweep that ran but found no knee (all targets stable, or all
+    unstable) exits nonzero yet still flushes the payload: that round
+    folds cleanly with ``knee_events_per_sec`` null and its nonzero
+    ``rc`` visible.
+    """
+    row: Dict[str, object] = {"path": os.path.basename(path),
+                              "round": -1, "rc": None, "dead": True,
+                              "lost_final_block": True,
+                              "targets": None, "n_stable": None,
+                              "n_unstable": None,
+                              "knee_events_per_sec": None,
+                              "knee_achieved_events_per_sec": None,
+                              "knee_ttvc_p99": None,
+                              "problems": []}
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as err:
+        row["problems"].append(f"unreadable record: {err}")
+        return row
+    row["round"] = _round_no(path, record)
+    row["rc"] = record.get("rc")
+    tail = record.get("tail")
+    if not isinstance(tail, str) or not tail.strip():
+        row["problems"].append("empty tail — sweep output lost")
+        return row
+    row["dead"] = False
+    try:
+        payload = json.loads(tail.strip().splitlines()[-1])
+    except ValueError:
+        payload = None
+    if not isinstance(payload, dict) or \
+            payload.get("record") != "load_sweep":
+        row["problems"].append(
+            "lost final block — tail does not end in a load_sweep "
+            "record")
+        return row
+    row["lost_final_block"] = False
+    rates = payload.get("rates")
+    rates = rates if isinstance(rates, list) else []
+    stable = [r for r in rates
+              if isinstance(r, dict) and r.get("stable") is True]
+    row.update(targets=payload.get("targets"),
+               n_stable=len(stable),
+               n_unstable=sum(1 for r in rates
+                              if isinstance(r, dict)
+                              and r.get("stable") is False))
+    knee = payload.get("knee")
+    if isinstance(knee, dict):
+        row.update(
+            knee_events_per_sec=_rate(knee, "target_events_per_sec"),
+            knee_achieved_events_per_sec=_rate(
+                knee, "achieved_events_per_sec"),
+            knee_ttvc_p99=_rate(knee, "ticks_to_view_change_p99"))
+    else:
+        row["problems"].append(
+            "no knee — every target classified the same way "
+            "(widen --targets)")
+    return row
+
+
 def _fold_multichip(path: str) -> Dict[str, object]:
     row: Dict[str, object] = {"path": os.path.basename(path),
                               "round": -1, "rc": None, "ok": None,
@@ -271,15 +348,21 @@ def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
     soak_rows = [_fold_soak(p) for p in
                  sorted(glob.glob(os.path.join(directory,
                                                "SOAK_r*.json")))]
+    sweep_rows = [_fold_loadsweep(p) for p in
+                  sorted(glob.glob(os.path.join(directory,
+                                                "LOADSWEEP_r*.json")))]
     return {"record": "bench_history",
             "directory": directory,
             "baseline": _baseline_row(baseline_path),
             "rounds": bench_rows,
             "multichip": multichip_rows,
             "soak": soak_rows,
+            "load_sweep": sweep_rows,
             "dead_rounds": [r["path"] for r in bench_rows if r["dead"]]
                            + [r["path"] for r in soak_rows
-                              if r["dead"] or r["lost_final_heartbeat"]],
+                              if r["dead"] or r["lost_final_heartbeat"]]
+                           + [r["path"] for r in sweep_rows
+                              if r["dead"] or r["lost_final_block"]],
             "partial_rounds": [r["path"] for r in bench_rows
                                if r["partial"]]}
 
@@ -325,6 +408,23 @@ def render(report: Dict[str, object]) -> str:
                      f"ttvc p99 {_fmt(row['ttvc_p99'])})")
         lines.append(f"soak r{row['round']:02d}: {state} "
                      f"(rc={row['rc']})")
+    for row in report.get("load_sweep", []):
+        if row["dead"]:
+            state = "DEAD"
+        elif row["lost_final_block"]:
+            state = "LOST FINAL BLOCK"
+        elif row["knee_events_per_sec"] is None:
+            state = (f"NO KNEE ({row['n_stable']} stable / "
+                     f"{row['n_unstable']} unstable)")
+        else:
+            state = (f"knee {_fmt(row['knee_events_per_sec'])} ev/s "
+                     f"(achieved "
+                     f"{_fmt(row['knee_achieved_events_per_sec'])}, "
+                     f"ttvc p99 {_fmt(row['knee_ttvc_p99'])}; "
+                     f"{row['n_stable']} stable / "
+                     f"{row['n_unstable']} unstable)")
+        lines.append(f"load-sweep r{row['round']:02d}: {state} "
+                     f"(rc={row['rc']})")
     return "\n".join(lines)
 
 
@@ -345,12 +445,13 @@ def main(argv=None) -> int:
 
     report = build_report(args.dir, args.baseline)
     if not report["rounds"] and not report["multichip"] \
-            and not report["soak"]:
-        print(f"bench_history: no BENCH_r*/MULTICHIP_r*/SOAK_r* records "
-              f"under {args.dir}", file=sys.stderr)
+            and not report["soak"] and not report["load_sweep"]:
+        print(f"bench_history: no BENCH_r*/MULTICHIP_r*/SOAK_r*/"
+              f"LOADSWEEP_r* records under {args.dir}", file=sys.stderr)
         return 1
     print(render(report))
-    for row in report["rounds"] + report["multichip"] + report["soak"]:
+    for row in (report["rounds"] + report["multichip"]
+                + report["soak"] + report["load_sweep"]):
         for problem in row["problems"]:
             print(f"bench_history: WARNING: {row['path']}: {problem}",
                   file=sys.stderr)

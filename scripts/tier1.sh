@@ -366,6 +366,99 @@ sys.exit(0 if ok else 1)'; then
     fi
 fi
 
+# Servo smoke: a short closed-loop soak under the target-rate load
+# servo with the live status API attached. The JSONL stream must
+# validate (schema v10: chunk-0 compile_s split, servo + rolling slo
+# blocks on every heartbeat), the status file must hold a schema-valid
+# status_snapshot, a concurrent `watch` subscriber must receive at
+# least one schema-valid snapshot line over the unix socket while the
+# run is live, and the summary's servo block must carry the target and
+# a committed quantized rate.
+if [ "$rc" -eq 0 ]; then
+    rm -f /tmp/_t1_status.sock /tmp/_t1_watch.json
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.service \
+            --soak --ticks 1024 --chunk 256 --n 16 --capacity 48 \
+            --recorder 8 --no-tick-rows --target-rate 50 --slo-window 4 \
+            --status /tmp/_t1_status.json \
+            --status-socket /tmp/_t1_status.sock \
+            --out /tmp/_t1_servo.jsonl > /tmp/_t1_servo.out &
+    servo_pid=$!
+    python -c '
+import socket, sys, time
+deadline = time.time() + 240
+line = b""
+while time.time() < deadline and not line:
+    try:
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.settimeout(max(1.0, deadline - time.time()))
+        c.connect("/tmp/_t1_status.sock")
+        c.sendall(b"watch\n")
+        line = c.makefile("rb").readline()
+        c.close()
+    except OSError:
+        time.sleep(0.2)
+sys.stdout.write(line.decode())' > /tmp/_t1_watch.json
+    if wait "$servo_pid" \
+        && test -s /tmp/_t1_watch.json \
+        && python -m rapid_tpu.telemetry.schema --status \
+            /tmp/_t1_watch.json \
+        && python -m rapid_tpu.telemetry.schema --streaming \
+            /tmp/_t1_servo.jsonl \
+        && python -m rapid_tpu.telemetry.schema --status \
+            /tmp/_t1_status.json \
+        && tail -n 1 /tmp/_t1_servo.out | python -c '
+import json, sys
+s = json.loads(sys.stdin.read())
+chunks = [json.loads(line) for line in open("/tmp/_t1_servo.jsonl")
+          if json.loads(line).get("record") == "chunk"]
+servo = s["servo"]
+q = servo["config"]["rate_quantum_per_ktick"]
+rate = servo["final"]["rate_per_ktick"]
+ok = (s["record"] == "stream_summary"
+      and servo["config"]["target_events_per_sec"] == 50.0
+      and abs(rate / q - round(rate / q)) < 1e-9
+      and s["compile_s"] is not None
+      and chunks and chunks[0]["compile_s"] is not None
+      and all(c["compile_s"] is None for c in chunks[1:])
+      and all(c["servo"] is not None and c["slo"] is not None
+              for c in chunks))
+sys.exit(0 if ok else 1)'; then
+        echo SERVO_SMOKE=ok
+    else
+        echo SERVO_SMOKE=failed
+        rc=1
+    fi
+fi
+
+# Receiver-resident smoke: the per-receiver twin of the soak (packed
+# carry, two-zone schedule) must run chunked with one mid-run
+# checkpoint save/restore round trip — the CLI exits 1 unless the
+# restored packed carry, continuation logs, final state and recorder
+# ring are all bit-identical — and its stream must validate.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.service \
+            --rx-soak --n 64 --ticks 64 --chunk 16 --recorder 4 \
+            --slo-window 4 --out /tmp/_t1_rxsoak.jsonl \
+            > /tmp/_t1_rxsoak.out \
+        && python -m rapid_tpu.telemetry.schema --streaming \
+            /tmp/_t1_rxsoak.jsonl \
+        && tail -n 1 /tmp/_t1_rxsoak.out | python -c '
+import json, sys
+s = json.loads(sys.stdin.read())
+ck = s["checkpoint"]
+ok = (s["record"] == "stream_summary"
+      and s["source"] == "resident_receiver"
+      and ck["state_identical"] and ck["logs_identical"]
+      and ck["final_identical"] and ck["recorder_identical"]
+      and ck["continuation_recorder_identical"])
+sys.exit(0 if ok else 1)'; then
+        echo RX_RESIDENT_SMOKE=ok
+    else
+        echo RX_RESIDENT_SMOKE=failed
+        rc=1
+    fi
+fi
+
 # Kernel-profile smoke: the per-kernel cost observatory must lower every
 # sub-kernel and emit a schema-valid dominance report (small N, few
 # repeats — the full 1k/10k/100k sweep is run manually; see

@@ -371,6 +371,61 @@ def test_resident_stream_validates_and_memory_stays_flat(tmp_path):
     assert marks["steady_max"] <= marks["max"]
 
 
+def test_resident_chunk0_compile_split_pins_rates(tmp_path):
+    # Schema v10: the one-time trace+compile wall is split out of
+    # chunk 0 (``compile_s``) so every heartbeat rate measures
+    # execution. Later chunks re-enter the compiled executable and
+    # report null.
+    from rapid_tpu.campaign import _rate as rate_fn
+
+    settings = _resident_settings()
+    eng = boot_resident(settings, capacity=24, n_initial=10, seed=0,
+                        traffic_config=TRAFFIC, write_ticks=False)
+    eng.run(3)
+    eng.flush()
+    recs = eng.chunk_records
+    summary = eng.summary()
+    eng.close()
+    assert recs[0]["compile_s"] is not None and recs[0]["compile_s"] > 0
+    assert all(r["compile_s"] is None for r in recs[1:])
+    assert summary["compile_s"] == recs[0]["compile_s"]
+    for r in recs:
+        assert r["ticks_per_sec"] == rate_fn(r["ticks"], r["wall_s"])
+
+
+@pytest.mark.parametrize("settings", [PACKED_REC, REC],
+                         ids=["packed", "dense"])
+def test_rx_resident_round_trip_and_stream_validate(tmp_path, settings):
+    from rapid_tpu.service import ResidentReceiver, boot_resident_receiver
+    from rapid_tpu.telemetry.slo import SloWindows
+
+    sink = str(tmp_path / "rx.jsonl")
+    rx = boot_resident_receiver(settings, 16, seed=3, horizon_ticks=64,
+                                chunk_ticks=16,
+                                slo=SloWindows(window_chunks=4), sink=sink)
+    rx.run(1)
+    block = rx.verify_round_trip(str(tmp_path / "ck"))
+    assert block["state_identical"] and block["logs_identical"]
+    assert block["final_identical"] and block["recorder_identical"]
+    assert block["continuation_recorder_identical"]
+    rx.run(1)
+    path = str(tmp_path / "ck2")
+    rx.save(path)
+    twin = ResidentReceiver.restore(path, rx._faults, settings)
+    assert twin.chunks == rx.chunks and twin.ticks == rx.ticks
+    rx.run(1)
+    twin.run(1)
+    _tree_equal(twin.carry, rx.carry, "resumed receiver carry")
+    _tree_equal(twin._rec, rx._rec, "resumed receiver recorder")
+    summary = rx.summary()
+    rx.close()
+    twin.close()
+    assert summary["source"] == "resident_receiver"
+    assert summary["chunks"] == 4 and summary["ticks"] == 64
+    with open(sink) as fh:
+        assert validate_streaming_stream(fh.readlines()) == []
+
+
 def test_resident_save_restore_resumes_bit_identically(tmp_path):
     settings = _resident_settings()
     eng = boot_resident(settings, capacity=24, n_initial=10, seed=0,

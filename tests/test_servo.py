@@ -1,0 +1,468 @@
+"""Streaming observatory: load servo, SLO windows, status API.
+
+The load-bearing proofs:
+
+- the servo's control law is quantized and deterministic: pinned
+  throughput makes the whole closed loop a pure function of the target
+  (identical rate traces whatever walls it observes), and the state
+  dict round-trips exactly;
+- closed-loop traffic sampling is chunk-split invariant *including
+  mid-stream retargeting*: the rng stream advances one uniform per tick
+  whatever the rate, so a servo-driven resident run is bit-identical
+  across chunkings (the full pipeline test runs two residents at
+  different ``stream_chunk_ticks`` under a pinned servo);
+- forced saturation behaves: a target far past what burst admission can
+  lower produces a monotonically growing backlog, which the sweep's
+  slope rule classifies as unstable;
+- the rolling SLO windows are bounded and exact: nearest-rank
+  percentiles over fixed bucket edges, eviction after
+  ``window_chunks``, and both view-change folds (engine stream,
+  per-slot receiver) are chunk-boundary invariant;
+- the status API never perturbs the protocol stream: a resident run
+  with the file + socket publishers attached emits byte-identical
+  non-wall JSONL to one without, and the socket serves ``status`` /
+  ``watch`` / unknown-command correctly;
+- the new schema v10 validators accept the shapes the service emits and
+  reject the mutations they exist to catch.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from rapid_tpu.service import (LoadServo, ServoConfig, StatusPublisher,
+                               TrafficConfig, TrafficGenerator,
+                               boot_resident, read_status)
+from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry.slo import (DEFAULT_BUCKET_EDGES,
+                                     ReceiverViewChangeFold, SloWindows,
+                                     ViewChangeFold)
+from rapid_tpu.telemetry.schema import (validate_load_sweep,
+                                        validate_slo_window,
+                                        validate_status_snapshot,
+                                        validate_streaming_stream)
+
+SETTINGS = Settings()
+
+
+# ---------------------------------------------------------------------------
+# servo control law
+# ---------------------------------------------------------------------------
+
+
+def test_servo_config_validates():
+    with pytest.raises(ValueError):
+        ServoConfig(target_events_per_sec=0.0)
+    with pytest.raises(ValueError):
+        ServoConfig(target_events_per_sec=10.0, gain=0.0)
+    with pytest.raises(ValueError):
+        ServoConfig(target_events_per_sec=10.0, rate_quantum_per_ktick=0.0)
+    with pytest.raises(ValueError):
+        ServoConfig(target_events_per_sec=10.0, min_rate_per_ktick=2.0,
+                    max_rate_per_ktick=1.0)
+    with pytest.raises(ValueError):
+        ServoConfig(target_events_per_sec=10.0, pinned_ticks_per_sec=-1.0)
+
+
+def test_servo_rate_quantized_and_clamped():
+    servo = LoadServo(ServoConfig(target_events_per_sec=10.0,
+                                  initial_ticks_per_sec=1000.0))
+    # 1000 * 10 / 1000 = 10 events/ktick, already on the 0.25 grid.
+    assert servo.rate_per_ktick == 10.0
+    # Every committed rate lands exactly on the quantum grid.
+    servo.observe(ticks=512, wall_s=512 / 1537.0, backlog=0)
+    q = servo.config.rate_quantum_per_ktick
+    assert servo.rate_per_ktick == round(servo.rate_per_ktick / q) * q
+    # An absurd target clamps at the rate ceiling.
+    hot = LoadServo(ServoConfig(target_events_per_sec=1e9,
+                                initial_ticks_per_sec=1000.0))
+    assert hot.rate_per_ktick == hot.config.max_rate_per_ktick
+
+
+def test_servo_pinned_is_pure_function_of_target():
+    cfg = ServoConfig(target_events_per_sec=80.0,
+                      pinned_ticks_per_sec=4000.0)
+    a, b = LoadServo(cfg), LoadServo(cfg)
+    # Feed the two servos wildly different measured walls: pinned
+    # throughput must ignore them all, so the rate trace depends on the
+    # target alone.
+    for wall in (0.01, 3.0, 0.5, 120.0):
+        a.observe(ticks=512, wall_s=wall, backlog=0)
+        b.observe(ticks=512, wall_s=wall * 7 + 0.2, backlog=0)
+        assert a.rate_per_ktick == b.rate_per_ktick == 20.0
+        assert a.ticks_per_sec_estimate == 4000.0
+    assert a.updates == b.updates == 0
+
+
+def test_servo_skips_unmeasurable_walls_and_tracks_backlog():
+    servo = LoadServo(ServoConfig(target_events_per_sec=10.0))
+    before = servo.rate_per_ktick
+    servo.observe(ticks=512, wall_s=1e-9, backlog=17)
+    assert servo.updates == 0 and servo.rate_per_ktick == before
+    assert servo.backlog == 17
+    servo.observe(ticks=512, wall_s=0.25, backlog=3)
+    assert servo.updates == 1 and servo.backlog == 3
+
+
+def test_servo_state_dict_round_trip():
+    servo = LoadServo(ServoConfig(target_events_per_sec=42.0))
+    servo.observe(ticks=512, wall_s=0.1, backlog=5)
+    twin = LoadServo.from_state(servo.state_dict())
+    assert twin.state_dict() == servo.state_dict()
+    assert twin.rate_per_ktick == servo.rate_per_ktick
+    assert twin.ticks_per_sec_estimate == servo.ticks_per_sec_estimate
+
+
+# ---------------------------------------------------------------------------
+# closed-loop sampling: rate-independent rng advancement
+# ---------------------------------------------------------------------------
+
+
+def _drain_chunks(gen, total, chunk, retarget=None):
+    """Run ``total`` ticks in ``chunk``-sized windows, collecting
+    (kind, tick, slot) event tuples; ``retarget`` maps a tick boundary
+    to a new join rate applied there."""
+    from rapid_tpu.engine.state import I32_MAX
+
+    events = []
+    for start in range(0, total, chunk):
+        if retarget and start in retarget:
+            gen.set_join_rate(retarget[start])
+        schedule, _ = gen.next_chunk(chunk)
+        if schedule is None:
+            continue
+        for kind, ticks in (("join", schedule.join_tick),
+                            ("leave", schedule.leave_tick)):
+            for slot, tick in enumerate(np.asarray(ticks)):
+                if tick != I32_MAX:
+                    events.append((kind, int(tick), slot))
+    return sorted(events)
+
+
+def _closed_gen(rate=40.0):
+    cfg = TrafficConfig(seed=11, join_rate_per_ktick=rate,
+                        leave_burst_rate_per_ktick=4.0, leave_burst_size=2,
+                        closed_loop=True)
+    return TrafficGenerator(cfg, SETTINGS, 32, 12)
+
+
+def test_closed_loop_chunk_split_invariant_under_retargeting():
+    # Same seed, same retarget schedule (rate doubles at tick 256),
+    # different chunkings: the drawn event streams must be identical —
+    # closed-loop sampling consumes exactly one uniform per tick
+    # whatever the rate, so retargeting never shifts the stream.
+    retarget = {256: 80.0}
+    a = _drain_chunks(_closed_gen(), 512, 64, retarget)
+    b = _drain_chunks(_closed_gen(), 512, 256, retarget)
+    assert a == b
+    assert a, "expected the closed-loop stream to draw events"
+
+
+def test_open_loop_rejects_retargeting():
+    cfg = TrafficConfig(seed=0, join_rate_per_ktick=10.0)
+    gen = TrafficGenerator(cfg, SETTINGS, 24, 10)
+    with pytest.raises(ValueError):
+        gen.set_join_rate(20.0)
+    with pytest.raises(ValueError):
+        _closed_gen().set_join_rate(-1.0)
+
+
+def test_resident_closed_loop_chunk_split_invariance():
+    # The full-pipeline form of the invariance: two servo-driven
+    # residents (pinned throughput model, so the rate trace is a pure
+    # function of the target) at different chunk sizes reach the same
+    # tick with bit-identical engine state.
+    def run(chunk_ticks, n_chunks):
+        settings = SETTINGS.with_(stream_chunk_ticks=chunk_ticks)
+        traffic = TrafficConfig(seed=5, join_rate_per_ktick=0.0,
+                                leave_burst_rate_per_ktick=4.0,
+                                leave_burst_size=2, closed_loop=True)
+        servo = LoadServo(ServoConfig(target_events_per_sec=60.0,
+                                      pinned_ticks_per_sec=2000.0))
+        eng = boot_resident(settings, 24, 10, seed=0,
+                            traffic_config=traffic, servo=servo,
+                            write_ticks=False)
+        eng.run(n_chunks)
+        eng.flush()
+        state = eng.state
+        eng.close()
+        return state
+
+    import jax
+
+    a = run(32, 8)
+    b = run(64, 4)
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_forced_saturation_backlog_grows_monotonically():
+    # A target far past what burst admission can lower: the servo pins
+    # the rate at its ceiling and the offered-minus-applied backlog must
+    # grow monotonically — the signature the load sweep classifies as
+    # unstable.
+    settings = SETTINGS.with_(stream_chunk_ticks=64)
+    traffic = TrafficConfig(seed=3, join_rate_per_ktick=0.0,
+                            leave_burst_rate_per_ktick=0.0,
+                            closed_loop=True)
+    servo = LoadServo(ServoConfig(target_events_per_sec=1e6,
+                                  pinned_ticks_per_sec=1000.0))
+    eng = boot_resident(settings, 24, 10, seed=0, traffic_config=traffic,
+                        servo=servo, write_ticks=False)
+    eng.run(6)
+    eng.flush()
+    backlogs = [r["servo"]["backlog"] for r in eng.chunk_records]
+    eng.close()
+    assert all(b2 >= b1 for b1, b2 in zip(backlogs, backlogs[1:]))
+    assert backlogs[-1] > backlogs[0] > 0
+    # The sweep's slope rule calls this unstable at any sane threshold.
+    slope = (backlogs[-1] - backlogs[0]) / (len(backlogs) - 1)
+    assert slope > 5.0
+
+
+# ---------------------------------------------------------------------------
+# rolling SLO windows
+# ---------------------------------------------------------------------------
+
+
+def test_slo_window_percentiles_nearest_rank():
+    slo = SloWindows(window_chunks=4)
+    block = slo.fold_chunk({"decide_latency": [1, 2, 3, 100],
+                            "ticks_to_view_change": [500] * 99 + [4000]})
+    lat = block["metrics"]["decide_latency"]
+    # Samples 1,2,3,100 land in buckets with edges 1,2,4,128.
+    assert lat["count"] == 4
+    assert lat["p50"] == 2 and lat["p95"] == 128 and lat["p99"] == 128
+    ttvc = block["metrics"]["ticks_to_view_change"]
+    assert ttvc["p50"] == 512 and ttvc["p99"] == 512
+    assert ttvc["counts"][DEFAULT_BUCKET_EDGES.index(4096)] == 1
+    assert validate_slo_window(block) == []
+
+
+def test_slo_window_evicts_beyond_window():
+    slo = SloWindows(window_chunks=2)
+    slo.fold_chunk({"decide_latency": [1000]})
+    slo.fold_chunk({"decide_latency": [1]})
+    block = slo.fold_chunk({"decide_latency": [1]})
+    lat = block["metrics"]["decide_latency"]
+    # The 1000-tick sample fell out of the 2-chunk window.
+    assert lat["count"] == 2 and lat["p99"] == 1
+    assert block["chunks"] == 2
+    empty = SloWindows(window_chunks=2).block()
+    assert empty["metrics"]["decide_latency"]["p50"] is None
+
+
+def test_slo_state_dict_round_trip():
+    slo = SloWindows(window_chunks=3)
+    slo.fold_chunk({"decide_latency": [5, 7], "ticks_to_view_change": [9]})
+    twin = SloWindows.from_state(slo.state_dict())
+    assert twin.block() == slo.block()
+
+
+class _Row:
+    def __init__(self, tick, announce=False, decide=False):
+        self.tick = tick
+        self.announce = announce
+        self.decide = decide
+
+
+def test_view_change_fold_chunk_boundary_invariant():
+    rows = [_Row(0), _Row(3, announce=True), _Row(7, decide=True),
+            _Row(12, announce=True), _Row(13, announce=True),
+            _Row(20, decide=True), _Row(31, decide=True)]
+    whole = ViewChangeFold(0).fold(rows)
+    assert whole["ticks_to_view_change"] == [7, 13, 11]
+    assert whole["decide_latency"] == [4, 7]
+
+    split = ViewChangeFold(0)
+    merged = {"ticks_to_view_change": [], "decide_latency": []}
+    for cut in (rows[:2], rows[2:5], rows[5:]):
+        part = split.fold(cut)
+        for key in merged:
+            merged[key].extend(part[key])
+    assert merged == whole
+
+
+def test_receiver_view_change_fold_per_slot_and_split_invariant():
+    ticks = np.arange(8)
+    announce = np.zeros((8, 3), bool)
+    decide = np.zeros((8, 3), bool)
+    announce[1, 0] = True
+    decide[3, 0] = True      # slot 0: announce@1 -> decide@3
+    decide[5, [0, 2]] = True  # slot 0 again (no announce), slot 2 cold
+    announce[6, 1] = True
+    decide[7, 1] = True      # slot 1: announce@6 -> decide@7
+
+    whole = ReceiverViewChangeFold(3).fold(ticks, announce, decide)
+    assert whole["ticks_to_view_change"] == [3, 2, 5, 7]
+    assert whole["decide_latency"] == [2, 1]
+
+    split = ReceiverViewChangeFold(3)
+    merged = {"ticks_to_view_change": [], "decide_latency": []}
+    for lo, hi in ((0, 4), (4, 6), (6, 8)):
+        part = split.fold(ticks[lo:hi], announce[lo:hi], decide[lo:hi])
+        for key in merged:
+            merged[key].extend(part[key])
+    assert merged == whole
+    twin = ReceiverViewChangeFold.from_state(split.state_dict())
+    assert twin.state_dict() == split.state_dict()
+
+
+# ---------------------------------------------------------------------------
+# status API
+# ---------------------------------------------------------------------------
+
+
+def test_status_file_and_socket_serve_latest(tmp_path):
+    file_path = str(tmp_path / "status.json")
+    sock_path = str(tmp_path / "status.sock")
+    pub = StatusPublisher(file_path=file_path, socket_path=sock_path)
+    try:
+        pub.publish({"record": "status_snapshot", "tick": 1})
+        pub.publish({"record": "status_snapshot", "tick": 2})
+        with open(file_path) as fh:
+            assert json.load(fh)["tick"] == 2
+        assert read_status(sock_path)[0]["tick"] == 2
+        err = read_status(sock_path, command="bogus")[0]
+        assert "error" in err
+    finally:
+        pub.close()
+    assert not os.path.exists(sock_path)
+
+
+def test_status_watch_streams_subsequent_snapshots(tmp_path):
+    sock_path = str(tmp_path / "status.sock")
+    pub = StatusPublisher(socket_path=sock_path)
+    try:
+        pub.publish({"tick": 1})
+        got = []
+        done = threading.Event()
+
+        def subscriber():
+            got.extend(read_status(sock_path, command="watch",
+                                   max_lines=3, timeout=10.0))
+            done.set()
+
+        t = threading.Thread(target=subscriber, daemon=True)
+        t.start()
+        # The subscriber receives the latest snapshot at subscription
+        # time, then every subsequent publish (it may register between
+        # publishes, so only monotonicity is deterministic here).
+        for tick in (2, 3, 4, 5):
+            pub.publish({"tick": tick})
+            if done.wait(0.05):
+                break
+        assert done.wait(10.0)
+        t.join(10.0)
+        assert len(got) == 3
+        ticks = [s["tick"] for s in got]
+        assert ticks == sorted(ticks) and ticks[0] >= 1
+    finally:
+        pub.close()
+
+
+def _wall_free(record):
+    """Strip the wall-clock-derived fields (and the process-global
+    live-buffer gauge) so what remains is the deterministic protocol
+    stream."""
+    drop = {"wall_s", "compile_s", "ticks_per_sec", "events_per_sec",
+            "live_buffer_bytes", "ticks_per_sec_estimate"}
+    if not isinstance(record, dict):
+        return record
+    return {k: _wall_free(v) for k, v in record.items() if k not in drop}
+
+
+def test_status_publisher_does_not_perturb_stream(tmp_path):
+    # The non-perturbation proof: one servo-driven resident run with the
+    # status file + socket attached, one without, pinned throughput so
+    # the servo trace is deterministic — the non-wall JSONL fields must
+    # be identical line for line.
+    def run(status):
+        sink = str(tmp_path / ("with.jsonl" if status else "without.jsonl"))
+        settings = SETTINGS.with_(stream_chunk_ticks=32)
+        traffic = TrafficConfig(seed=9, join_rate_per_ktick=0.0,
+                                leave_burst_rate_per_ktick=4.0,
+                                leave_burst_size=2, closed_loop=True)
+        servo = LoadServo(ServoConfig(target_events_per_sec=50.0,
+                                      pinned_ticks_per_sec=2000.0))
+        eng = boot_resident(settings, 24, 10, seed=0,
+                            traffic_config=traffic, servo=servo,
+                            slo=SloWindows(window_chunks=4),
+                            status=status, sink=sink, write_ticks=False)
+        eng.run(4)
+        eng.summary()
+        eng.close()
+        with open(sink) as fh:
+            return fh.readlines()
+
+    pub = StatusPublisher(file_path=str(tmp_path / "status.json"),
+                          socket_path=str(tmp_path / "status.sock"))
+    with_status = run(pub)
+    without = run(None)
+    assert len(with_status) == len(without)
+    for line_a, line_b in zip(with_status, without):
+        assert _wall_free(json.loads(line_a)) == _wall_free(json.loads(line_b))
+    assert validate_streaming_stream(with_status) == []
+    # The published file is itself a valid status snapshot.
+    with open(tmp_path / "status.json") as fh:
+        assert validate_status_snapshot(json.load(fh)) == []
+
+
+# ---------------------------------------------------------------------------
+# schema v10 validators
+# ---------------------------------------------------------------------------
+
+
+def _sweep_payload():
+    def rate(target, stable):
+        slo = SloWindows(window_chunks=4)
+        block = slo.fold_chunk({"decide_latency": [3],
+                                "ticks_to_view_change": [40]})
+        cfg = ServoConfig(target_events_per_sec=target,
+                          pinned_ticks_per_sec=2000.0)
+        return {"target_events_per_sec": target,
+                "achieved_events_per_sec": target * 0.97,
+                "rate_per_ktick": 0.5 * target / 2.0,
+                "ticks_per_sec": 2000.0,
+                "chunks": 4, "events": 40,
+                "backlog_final": 0 if stable else 400,
+                "backlog_slope_per_chunk": 0.0 if stable else 99.0,
+                "stable": stable,
+                "servo_config": cfg.as_dict(),
+                "slo": block}
+
+    from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+    return {"record": "load_sweep", "schema_version": SCHEMA_VERSION,
+            "n": 24, "capacity": 96, "chunk_ticks": 512,
+            "chunks_per_rate": 4, "warmup_chunks": 1, "seed": 0,
+            "backlog_slope_threshold": 5.0,
+            "targets": [50.0, 100.0, 800.0],
+            "rates": [rate(50.0, True), rate(100.0, True),
+                      rate(800.0, False)],
+            "knee": {"target_events_per_sec": 100.0,
+                     "achieved_events_per_sec": 97.0,
+                     "ticks_to_view_change_p99": 64},
+            "wall_s": 12.5}
+
+
+def test_validate_load_sweep_accepts_and_rejects():
+    payload = _sweep_payload()
+    assert validate_load_sweep(payload) == []
+    wrong_knee = json.loads(json.dumps(payload))
+    wrong_knee["knee"]["target_events_per_sec"] = 50.0
+    assert any("knee" in e for e in validate_load_sweep(wrong_knee))
+    missing_rate = json.loads(json.dumps(payload))
+    missing_rate["rates"] = missing_rate["rates"][:2]
+    assert validate_load_sweep(missing_rate)
+    no_knee = json.loads(json.dumps(payload))
+    no_knee["knee"] = None
+    assert any("knee" in e for e in validate_load_sweep(no_knee))
+
+
+def test_validate_status_snapshot_rejects_wrong_record():
+    snap = {"record": "not_a_snapshot"}
+    assert validate_status_snapshot(snap)

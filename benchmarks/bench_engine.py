@@ -517,6 +517,13 @@ def main(argv=None) -> int:
     parser.add_argument("--profile-repeats", type=int, default=5,
                         help="timed dispatches per kernel in "
                              "--profile-sweep (default 5)")
+    parser.add_argument("--variant-sizes", type=int, nargs="+",
+                        default=None, metavar="N",
+                        help="--profile-sweep extra: profile the "
+                             "ring-variant aggregation kernel vs the "
+                             "dense broadcast at these sizes (dense "
+                             "sizes over the memory budget become "
+                             "documented refusals)")
     parser.add_argument("--trace", type=str, default=None, metavar="FILE",
                         help="write a Chrome/Perfetto trace-event JSON of "
                              "the measured run (open at ui.perfetto.dev)")
@@ -539,7 +546,8 @@ def main(argv=None) -> int:
 
         report = dominance_report(args.profile_sizes, settings,
                                   repeats=args.profile_repeats,
-                                  seed=args.seed)
+                                  seed=args.seed,
+                                  variant_sizes=args.variant_sizes)
         if args.out:
             from rapid_tpu.telemetry import write_json_artifact
 

@@ -85,11 +85,29 @@ emits one JSONL heartbeat line per completed dispatch — now carrying
 ``pool_id``/``pool_shape`` and ``in_flight_dispatches`` — so long
 pipelined campaigns are observable mid-run.
 
+Protocol-variant tournaments (schema v11): ``--tournament rapid,ring``
+runs every sampled member once per variant — same seeds, same fault
+schedules, same identities (``protocol_variant`` never feeds the
+scenario sampler) — and reports a ``campaign.tournament`` block:
+per-variant decide tails, total message counts, fallback-member rates,
+and per-kind win/loss (earlier first decide wins; decided beats
+undecided; equal is a tie). Variant members run the shared-state path
+only (the per-receiver engine is reference-protocol-only), so
+tournament weight mixes must exclude the latency family, and the host
+referee replays the reference protocol, so non-rapid campaigns reject
+``--spot-checks``. Every campaign block records its
+``protocol_variant`` so ``rapid_tpu.replay`` re-derives the variant
+from the payload alone.
+
 CLI::
 
     python -m rapid_tpu.campaign --clusters 1024 --n 64 --ticks 240 \
         --seed 0 --fleet-size 64 --spot-checks 8 --out campaign.json \
         --trace campaign_trace.json --progress -
+
+    python -m rapid_tpu.campaign --clusters 256 --n 32 --ticks 160 \
+        --weights crash=2,contested=1,churn=1 \
+        --tournament rapid,ring --out tournament.json
 """
 from __future__ import annotations
 
@@ -109,7 +127,7 @@ from rapid_tpu.faults import (DEFAULT_SCENARIO_WEIGHTS, DELAY_KINDS,
                               ScenarioWeights, sample_adversary_schedule)
 from rapid_tpu.settings import Settings
 
-__all__ = ["CampaignConfig", "run_campaign", "main"]
+__all__ = ["CampaignConfig", "run_campaign", "run_tournament", "main"]
 
 #: Spot-check kinds the acceptance gate requires when the budget allows:
 #: a partition (link-masked FD path), a contested split (classic-Paxos
@@ -239,6 +257,12 @@ class CampaignConfig:
     # exemplars in the payload. 0 (default) compiles the recorder out —
     # byte-identical member programs to a recorder-less build.
     flight_recorder: int = 0
+    # Dissemination/consensus variant every member runs
+    # (rapid_tpu.variants): "rapid" (default, byte-identical programs),
+    # "ring" (segmented-scan ring aggregation, O(N) wire), or "hier"
+    # (two-level seeded-group consensus). Never feeds the scenario
+    # sampler, so a tournament's variants see identical schedules.
+    protocol_variant: str = "rapid"
 
 
 def _receiver_eligible(sc: SampledScenario) -> bool:
@@ -635,7 +659,9 @@ def _device_peak_bytes(jax) -> Optional[int]:
 
 
 def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
-                 progress_path: Optional[str] = None) -> Dict[str, object]:
+                 progress_path: Optional[str] = None,
+                 member_stats_out: Optional[List[Dict[str, object]]] = None,
+                 ) -> Dict[str, object]:
     """Run one campaign; returns a schema-v7 bench run payload.
 
     The payload validates as an ``engine_tick`` run (``telemetry`` is the
@@ -682,7 +708,33 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     from rapid_tpu.telemetry.schema import SCHEMA_VERSION
     from rapid_tpu.telemetry.trace import TraceWriter, wall_span
 
+    from rapid_tpu.variants import VARIANTS
+
+    if cfg.protocol_variant not in VARIANTS:
+        raise ValueError(f"protocol_variant must be one of {VARIANTS}, "
+                         f"got {cfg.protocol_variant!r}")
+    non_rapid = cfg.protocol_variant != "rapid"
+    if non_rapid:
+        w = cfg.weights or DEFAULT_SCENARIO_WEIGHTS
+        hot = [k for k in DELAY_KINDS if getattr(w, k) > 0]
+        if hot:
+            raise ValueError(
+                f"protocol_variant={cfg.protocol_variant!r} cannot run "
+                f"latency-family members {hot}: delay schedules dispatch "
+                f"through the per-receiver engine, which runs the "
+                f"reference protocol only — zero the {DELAY_KINDS} "
+                f"weights for variant campaigns")
+        if cfg.spot_checks:
+            raise ValueError(
+                f"protocol_variant={cfg.protocol_variant!r} rejects "
+                f"spot_checks={cfg.spot_checks}: the host referee replays "
+                f"the reference protocol (use "
+                f"engine.diff.run_variant_differential for variant "
+                f"exactness)")
+
     base = cfg.settings or Settings()
+    if base.protocol_variant != cfg.protocol_variant:
+        base = base.with_(protocol_variant=cfg.protocol_variant)
     c = cfg.n + cfg.headroom
     settings = base if base.capacity == c else base.with_(capacity=c)
     referee_settings = base if base.capacity == 0 else base.with_(capacity=0)
@@ -721,8 +773,12 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             t0 = time.perf_counter()
             scenarios.append(_sample_scenario(cfg, i))
             sample_s[i] = time.perf_counter() - t0
+    # Non-rapid variants live in the shared-state engine only: route
+    # everything shared (latency members — the one kind the shared wire
+    # cannot carry — were rejected above before sampling).
+    per_rx = cfg.per_receiver and not non_rapid
     rx_idx = [i for i, sc in enumerate(scenarios)
-              if (cfg.per_receiver and _receiver_eligible(sc))
+              if (per_rx and _receiver_eligible(sc))
               or _delay_member(sc)]
     sh_idx = [i for i in range(total) if i not in set(rx_idx)]
 
@@ -1048,6 +1104,28 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             regime_ticks[regime].append(s.ticks_to_first_decide)
     delay_regimes = regime_distributions(regime_ticks)
 
+    # Per-member tournament rows: everything ``run_tournament`` joins
+    # across variants, keyed by campaign member index (sorted, so the
+    # dispatch plan's pool order never leaks into the join). Derived
+    # from seed-deterministic folds only.
+    if member_stats_out is not None:
+        rows = []
+        for pos, i in enumerate(member_order):
+            s = summaries[pos]
+            classic = sum(int(s.fallback_phase_sent.get(p, 0))
+                          for p in ("phase1a", "phase1b",
+                                    "phase2a", "phase2b"))
+            rows.append({
+                "member": i, "kind": scenarios[i].kind,
+                "seed": _member_seed(cfg, i),
+                "decided": s.ticks_to_first_decide is not None,
+                "decide_tick": s.ticks_to_first_decide,
+                "total_sent": s.total_sent,
+                "fallback": classic > 0,
+            })
+        rows.sort(key=lambda r: r["member"])
+        member_stats_out.extend(rows)
+
     # Post-dispatch triage: classify every member, then attach the
     # flight-recorder rings of the (bounded) exemplar set only — the
     # per-dispatch host copies hold every member's compact ring, but
@@ -1128,7 +1206,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         rx_member_bytes = rx_packed.bundle_state_bytes(
             rx_capacity, rx_settings)
     per_receiver = {
-        "enabled": cfg.per_receiver,
+        "enabled": per_rx,
         "members": len(rx_idx),
         "dispatches": rx_dispatches,
         "fleet_size": fr,
@@ -1193,6 +1271,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
         },
         "campaign": {
             "seed": cfg.seed,
+            "protocol_variant": cfg.protocol_variant,
             "clusters": total,
             # Replay self-containment (schema v8): everything
             # ``rapid_tpu.replay`` needs to reconstruct the sampled
@@ -1214,6 +1293,95 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             "triage": triage,
         },
     }
+
+
+def run_tournament(cfg: CampaignConfig, variants: List[str], *,
+                   trace_path: Optional[str] = None,
+                   progress_path: Optional[str] = None
+                   ) -> Dict[str, object]:
+    """A/B tournament: the same campaign once per protocol variant.
+
+    Every variant runs the identical sampled member set — the scenario
+    sampler is seeded from ``cfg.seed``/``n``/``ticks``/``weights``
+    alone, which ``dataclasses.replace`` leaves untouched — so the
+    per-member join below compares each member against *itself* under a
+    different wire protocol: same faults, same identities, same scripted
+    proposes.
+
+    Returns the first variant's full payload with a
+    ``campaign.tournament`` block added: per-variant decide counts /
+    fallback members / total messages / nearest-rank decide-tick tails,
+    and per-kind win/loss where the earlier first decide wins, any
+    decide beats no decide, and equality is a tie. Every field is
+    seed-deterministic, so ``scripts/bench_compare.py``'s exact campaign
+    gate covers the whole block.
+    """
+    from rapid_tpu.telemetry.metrics import _dist
+
+    if len(variants) < 2:
+        raise ValueError(f"a tournament needs >= 2 variants, "
+                         f"got {variants}")
+    if len(set(variants)) != len(variants):
+        raise ValueError(f"duplicate tournament variants: {variants}")
+
+    payloads: Dict[str, Dict[str, object]] = {}
+    stats: Dict[str, List[Dict[str, object]]] = {}
+    for v in variants:
+        vcfg = dataclasses.replace(cfg, protocol_variant=v)
+        rows: List[Dict[str, object]] = []
+        # Trace/progress knobs ride the first variant only — they are
+        # I/O, not campaign identity.
+        first = v == variants[0]
+        payloads[v] = run_campaign(
+            vcfg, trace_path=trace_path if first else None,
+            progress_path=progress_path if first else None,
+            member_stats_out=rows)
+        stats[v] = rows
+
+    members = [r["member"] for r in stats[variants[0]]]
+    for v in variants[1:]:
+        assert [r["member"] for r in stats[v]] == members, \
+            "tournament variants diverged on the sampled member set"
+
+    per_variant: Dict[str, Dict[str, object]] = {}
+    for v in variants:
+        rows = stats[v]
+        ticks = [r["decide_tick"] for r in rows if r["decided"]]
+        per_variant[v] = {
+            "decided": sum(r["decided"] for r in rows),
+            "fallback_members": sum(r["fallback"] for r in rows),
+            "total_messages": sum(r["total_sent"] for r in rows),
+            "decide_ticks": _dist(ticks),
+        }
+
+    # Per-kind win/loss: rank each member's variants by
+    # (undecided-last, first-decide tick); a unique minimum wins, any
+    # shared minimum is a tie for that member.
+    win_loss: Dict[str, Dict[str, int]] = {}
+    by_member: Dict[str, Dict[int, Dict[str, object]]] = {
+        v: {r["member"]: r for r in stats[v]} for v in variants}
+    for i, ref in zip(members, stats[variants[0]]):
+        kind = ref["kind"]
+        row = win_loss.setdefault(
+            kind, {**{v: 0 for v in variants}, "tie": 0})
+        keys = {v: ((0, by_member[v][i]["decide_tick"])
+                    if by_member[v][i]["decided"] else (1, 0))
+                for v in variants}
+        best = min(keys.values())
+        winners = [v for v in variants if keys[v] == best]
+        if len(winners) == 1:
+            row[winners[0]] += 1
+        else:
+            row["tie"] += 1
+
+    payload = payloads[variants[0]]
+    payload["campaign"]["tournament"] = {
+        "variants": list(variants),
+        "clusters": len(members),
+        "per_variant": per_variant,
+        "win_loss": dict(sorted(win_loss.items())),
+    }
+    return payload
 
 
 def _parse_weights(text: str) -> ScenarioWeights:
@@ -1264,8 +1432,26 @@ def main(argv=None) -> int:
                              "shared wire cannot represent delays")
     parser.add_argument("--weights", type=_parse_weights, default=None,
                         metavar="K=W,...",
-                        help="scenario mix, e.g. crash=1,partition=2,"
-                             "flip_flop=0,contested=1,churn=1")
+                        help="scenario mix over "
+                             + ",".join(SCENARIO_KINDS)
+                             + " (missing kinds keep their defaults), "
+                               "e.g. crash=1,partition=2,delay=1,jitter=0")
+    parser.add_argument("--variant", type=str, default="rapid",
+                        choices=("rapid", "ring", "hier"),
+                        help="protocol variant every member runs "
+                             "(rapid_tpu.variants): 'rapid' (default), "
+                             "'ring' (O(N) ring dissemination), 'hier' "
+                             "(two-level group consensus). Non-rapid "
+                             "variants run the shared-state path only "
+                             "and reject latency-family weights and "
+                             "--spot-checks")
+    parser.add_argument("--tournament", type=str, default=None,
+                        metavar="V1,V2[,...]",
+                        help="A/B tournament: run every sampled member "
+                             "once per listed variant over identical "
+                             "schedules and report the "
+                             "campaign.tournament block (e.g. "
+                             "'rapid,ring'); overrides --variant")
     parser.add_argument("--out", type=str, default=None,
                         help="write the full payload JSON here")
     parser.add_argument("--trace", type=str, default=None, metavar="FILE",
@@ -1330,9 +1516,16 @@ def main(argv=None) -> int:
                          fleet_shard=args.fleet_shard,
                          compile_cache=args.compile_cache,
                          flight_recorder=args.flight_recorder,
-                         settings=settings)
-    payload = run_campaign(cfg, trace_path=args.trace,
-                           progress_path=args.progress)
+                         settings=settings,
+                         protocol_variant=args.variant)
+    if args.tournament:
+        variants = [v.strip() for v in args.tournament.split(",")
+                    if v.strip()]
+        payload = run_tournament(cfg, variants, trace_path=args.trace,
+                                 progress_path=args.progress)
+    else:
+        payload = run_campaign(cfg, trace_path=args.trace,
+                               progress_path=args.progress)
     if args.out:
         from rapid_tpu.telemetry import write_json_artifact
 

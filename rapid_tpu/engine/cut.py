@@ -49,6 +49,28 @@ def deliver_reports(xp, state: EngineState, src_alive):
     return by_dst & src_alive[state.obs_idx]
 
 
+def ring_deliver_reports(xp, state: EngineState, src_alive):
+    """bool [C, K]: ``deliver_reports`` lowered through the static ring-0
+    permutation — the ring dissemination variant's cut-delivery kernel.
+
+    Instead of every observer unicasting its report to every receiver,
+    contributions enter the ring in ring-0 position order (one token per
+    slot), circulate one lap, and are read back out at each observer's
+    rank. ``ring_order[:, 0]`` and ``ring_rank[:, 0]`` are inverse
+    permutations (``ring_order[ring_rank[s, 0], 0] == s``), so gathering
+    through the round trip is the identity on values: the result is
+    bit-identical to ``deliver_reports`` while the lowering — and the
+    O(N) per-tick message count the variant-aware oracle checks — is the
+    ring's. Churn-report delivery (``deliver_churn_reports``) stays
+    dense: join/leave batches are rare and already O(K) per event.
+    """
+    contrib = state.pending_deliver & src_alive[:, None]
+    token = contrib[state.ring_order[:, 0]]
+    by_slot = token[state.ring_rank[:, 0]]
+    by_dst = xp.take_along_axis(by_slot, state.obs_idx, axis=0)
+    return by_dst
+
+
 def deliver_churn_reports(xp, state: EngineState, src_alive):
     """(down, up) bool [C, K]: churn-pipeline reports landing this tick.
 

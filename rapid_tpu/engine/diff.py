@@ -619,6 +619,175 @@ def run_fallback_differential(
     )
 
 
+@dataclass
+class VariantDiffResult:
+    """Oracle vs engine under a ``Settings.protocol_variant`` message model.
+
+    The oracle still runs the reference protocol; its counters are
+    recomputed under the variant's wire accounting by
+    ``rapid_tpu.variants.oracle`` (which also certifies the scenario is
+    inside the variant's envelope — see ``VariantEnvelopeError``). The
+    bit-identical contract covers events, per-tick transformed message
+    counts, the final configuration id, and — for contested scenarios —
+    the per-phase consensus counts including the ring-shaped fast votes.
+    """
+
+    variant: str
+    n: int
+    n_ticks: int
+    contested: bool
+    oracle_events: List[ViewEvent]
+    engine_events: List[ViewEvent]
+    oracle_counters: List[Dict[str, int]]
+    engine_counters: List[Dict[str, int]]
+    oracle_config_id: int
+    engine_config_id: int
+    # per-phase consensus streams, compared only for contested scenarios
+    # (organic fast votes live in the vote class, not the px class)
+    oracle_phase_counters: Optional[List[Dict[str, int]]] = None
+    engine_phase_counters: Optional[List[Dict[str, int]]] = None
+    engine_metrics: Optional[List] = None
+    oracle_metrics: Optional[List] = None
+
+    @property
+    def oracle_message_total(self) -> int:
+        """Total variant-model messages the oracle accounts for the run."""
+        return sum(d["sent"] for d in self.oracle_counters)
+
+    @property
+    def engine_message_total(self) -> int:
+        """Total messages the engine's expanded factors account."""
+        return sum(d["sent"] for d in self.engine_counters)
+
+    def first_divergence(self):
+        """Earliest (tick, field) disagreement — None when bit-identical."""
+        from rapid_tpu.telemetry import forensics as fz
+
+        candidates = [
+            fz.events_divergence(self.engine_events, self.oracle_events),
+            fz.counters_divergence(self.engine_counters,
+                                   self.oracle_counters),
+            fz.scalar_divergence("config_id", self.engine_config_id,
+                                 self.oracle_config_id, tick=self.n_ticks),
+        ]
+        if self.oracle_phase_counters is not None:
+            candidates.append(fz.counters_divergence(
+                self.engine_phase_counters, self.oracle_phase_counters))
+        div = fz.earliest(candidates)
+        if div is None:
+            return None
+        return fz.build_report(div, engine_metrics=self.engine_metrics,
+                               oracle_metrics=self.oracle_metrics,
+                               events=self.oracle_events)
+
+    def assert_identical(self, artifact: Optional[str] = None) -> None:
+        """Raise ``DivergenceError`` at the first divergence; see
+        ``DiffResult.assert_identical`` for the artifact contract."""
+        report = self.first_divergence()
+        if report is not None:
+            _raise_divergence(report, artifact)
+
+
+def run_variant_differential(
+    n: int,
+    crash_ticks: Dict[int, int],
+    n_ticks: int,
+    variant: str,
+    settings: Optional[Settings] = None,
+    contested: Optional[Tuple] = None,
+    mesh=None,
+) -> VariantDiffResult:
+    """Replay a scenario through the variant engine and the variant-aware
+    oracle accounting.
+
+    With ``contested=None`` this is a crash scenario (``crash_ticks``
+    maps slot -> crash tick, like ``run_differential``); with
+    ``contested=(values, votes, delays)`` it is a scripted contested
+    consensus instance (like ``run_fallback_differential``;
+    ``crash_ticks`` must be empty). The engine runs with
+    ``settings.protocol_variant = variant`` while the oracle's counters
+    are transformed host-side by
+    ``rapid_tpu.variants.oracle.variant_oracle_counters`` — proving the
+    variant's decisions, config ids and per-tick message counts exactly.
+    Raises ``rapid_tpu.variants.oracle.VariantEnvelopeError`` for
+    scenarios where the variant legitimately behaves differently.
+    """
+    from rapid_tpu.variants import oracle as variants_oracle
+
+    settings = (settings or Settings()).with_(protocol_variant=variant)
+    uids = [uid_of(e) for e in default_endpoints(n)]
+
+    if contested is not None:
+        if crash_ticks:
+            raise ValueError("contested variant scenarios are crash-free; "
+                             "pass crash_ticks={}")
+        values, votes, delays = contested
+        base = run_fallback_differential(n, values, votes, delays, n_ticks,
+                                         settings=settings)
+        o_tick, o_phase = variants_oracle.variant_oracle_counters(
+            variant, n, {}, base.oracle_events, base.oracle_counters,
+            base.oracle_phase_counters, uids, contested=True)
+        return VariantDiffResult(
+            variant=variant, n=n, n_ticks=n_ticks, contested=True,
+            oracle_events=base.oracle_events,
+            engine_events=base.engine_events,
+            oracle_counters=o_tick,
+            engine_counters=base.engine_counters,
+            oracle_phase_counters=o_phase,
+            engine_phase_counters=base.engine_phase_counters,
+            oracle_config_id=base.oracle_config_id,
+            engine_config_id=base.engine_config_id,
+            engine_metrics=base.engine_metrics,
+            oracle_metrics=base.oracle_metrics,
+        )
+
+    # --- crash scenario: run_differential plus the per-phase capture ----
+    from rapid_tpu.engine import sharding as sharding_mod
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.state import state_config_id
+    from rapid_tpu.engine.step import simulate
+
+    endpoints = default_endpoints(n)
+    node_ids = default_node_ids(n)
+    fault_model = CrashFault({endpoints[s]: t
+                              for s, t in crash_ticks.items()})
+    network, clusters, recorders = boot_static_cluster(
+        settings, endpoints, node_ids, fault_model)
+    oracle_counts = run_oracle(network, n_ticks)
+    oracle_phase = [dict(d) for d in network.consensus_history]
+    alive = [s for s in range(n) if s not in crash_ticks]
+    events_oracle = oracle_events(recorders, alive)
+    oracle_cfg = clusters[alive[0]].membership_service.view \
+        .get_current_configuration_id()
+    o_tick, _ = variants_oracle.variant_oracle_counters(
+        variant, n, dict(crash_ticks), events_oracle, oracle_counts,
+        oracle_phase, uids, contested=False)
+
+    id_fp_sum = clusters[0].membership_service.view._id_fp_sum
+    state = init_state(uids, id_fp_sum, settings)
+    faults = crash_faults([crash_ticks.get(s, I32_MAX) for s in range(n)])
+    if mesh is not None:
+        capacity = int(state.member.shape[0])
+        state = sharding_mod.shard_put(state, mesh, capacity)
+        faults = sharding_mod.shard_put(faults, mesh, capacity)
+    final_state, logs = simulate(state, faults, n_ticks, settings, mesh=mesh)
+
+    from rapid_tpu.telemetry import metrics as telemetry_metrics
+
+    return VariantDiffResult(
+        variant=variant, n=n, n_ticks=n_ticks, contested=False,
+        oracle_events=events_oracle,
+        engine_events=engine_events(logs),
+        oracle_counters=o_tick,
+        engine_counters=expand_counters(logs),
+        oracle_config_id=oracle_cfg,
+        engine_config_id=state_config_id(final_state),
+        engine_metrics=telemetry_metrics.engine_metrics(logs),
+        oracle_metrics=telemetry_metrics.oracle_metrics(
+            oracle_counts, events_oracle),
+    )
+
+
 def run_churn_differential(
     n: int,
     capacity: int,

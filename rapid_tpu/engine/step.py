@@ -63,6 +63,8 @@ from rapid_tpu.engine.state import (I32_MAX, EngineFaults, EngineState,
                                     StepLog, config_id_limbs)
 from rapid_tpu.engine.topology import build_topology
 from rapid_tpu.settings import Settings
+from rapid_tpu.variants import hier as hier_mod
+from rapid_tpu.variants import ring as ring_mod
 
 _TRACE_COUNT = 0
 
@@ -124,11 +126,25 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     # ---- phase 1: vote delivery & decision -----------------------------
     votes_arriving = state.vote_pending & (state.announce_tick + 1 == t)
     valid = state.voters & ~crashed & votes_arriving
-    decided, tally = votes_mod.count_fast_round(
-        jnp,
-        jnp.broadcast_to(state.phash_hi, (c,)),
-        jnp.broadcast_to(state.phash_lo, (c,)),
-        valid, n_member, mesh=mesh)
+    # Protocol-variant dispatch (static knob, ``rapid_tpu.variants``):
+    # the "rapid" branch is the pre-knob code verbatim, so its traced
+    # jaxpr stays byte-identical (pinned in ``tests/test_variants.py``).
+    if settings.protocol_variant == "ring":
+        decided, tally = ring_mod.ring_count_fast_round(
+            jnp, state,
+            jnp.broadcast_to(state.phash_hi, (c,)),
+            jnp.broadcast_to(state.phash_lo, (c,)),
+            valid, n_member, mesh=mesh)
+    elif settings.protocol_variant == "hier":
+        decided, tally = hier_mod.hier_count_fast_round(
+            jnp, state.member, valid, state.uid_hi, state.uid_lo,
+            hier_mod.hier_group_count(c), mesh=mesh)
+    else:
+        decided, tally = votes_mod.count_fast_round(
+            jnp,
+            jnp.broadcast_to(state.phash_hi, (c,)),
+            jnp.broadcast_to(state.phash_lo, (c,)),
+            valid, n_member, mesh=mesh)
     vote_tally = jnp.where(votes_arriving, tally, 0).astype(jnp.int32)
     vote_quorum = jnp.where(
         votes_arriving, votes_mod.fast_quorum(jnp, n_member), 0
@@ -146,6 +162,24 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
         votes_arriving, valid.sum(), 0).astype(jnp.int32)
     vote_deliver_alive = jnp.where(
         votes_arriving, (state.member & ~crashed).sum(), 0).astype(jnp.int32)
+    # Variant message accounting for the vote *delivery* side. Ring: the
+    # surviving votes arrive as one aggregation lap + one dissemination
+    # lap (sender factor 2); hier: the whole exchange (intra-group votes
+    # + inter-group verdict + relay) is one factor with recipient 1.
+    if settings.protocol_variant == "ring":
+        vote_senders_alive = jnp.where(
+            votes_arriving & valid.any(), 2, 0).astype(jnp.int32)
+    elif settings.protocol_variant == "hier":
+        hier_vgate = (votes_arriving & valid.any()
+                      & (state.member & ~crashed).any())
+        vote_senders_alive = jnp.where(
+            hier_vgate,
+            hier_mod.hier_exchange_messages(
+                jnp, valid, state.member & ~crashed,
+                state.uid_hi, state.uid_lo,
+                hier_mod.hier_group_count(c)),
+            0).astype(jnp.int32)
+        vote_deliver_alive = jnp.where(hier_vgate, 1, 0).astype(jnp.int32)
 
     def do_view_change(pmask):
         removed = pmask & state.member
@@ -240,7 +274,11 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     batch_src = mid.pending_deliver.any(axis=1)
     flushers_alive = (batch_src & src_alive).sum().astype(jnp.int32)
     n_alive = (mid.member & ~crashed).sum().astype(jnp.int32)
-    delivered_down = cut.deliver_reports(jnp, mid, src_alive)
+    if settings.protocol_variant == "ring":
+        flushers_alive = ring_mod.ring_pair_factor(jnp, batch_src & src_alive)
+        delivered_down = cut.ring_deliver_reports(jnp, mid, src_alive)
+    else:
+        delivered_down = cut.deliver_reports(jnp, mid, src_alive)
     delivered_up = jnp.zeros_like(delivered_down)
     if churn is not None:
         churn_down, churn_up = cut.deliver_churn_reports(jnp, mid, src_alive)
@@ -268,12 +306,25 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
     vote_senders = jnp.where(announce_now, n_alive, 0).astype(jnp.int32)
     vote_recipients = jnp.where(
         announce_now, n_member_now, 0).astype(jnp.int32)
+    # Variant accounting for the vote *send* side (the announce tick).
+    if settings.protocol_variant == "ring":
+        vote_senders = jnp.where(announce_now, 2, 0).astype(jnp.int32)
+    elif settings.protocol_variant == "hier":
+        vote_senders = jnp.where(
+            announce_now,
+            hier_mod.hier_exchange_messages(
+                jnp, mid.member & ~crashed, mid.member,
+                mid.uid_hi, mid.uid_lo, hier_mod.hier_group_count(c)),
+            0).astype(jnp.int32)
+        vote_recipients = jnp.where(announce_now, 1, 0).astype(jnp.int32)
 
     # ---- phase 3: batch flush (1-tick quiescence) ----------------------
     flusher_mask = mid.pending_flush.any(axis=1)
     flushers = flusher_mask.sum().astype(jnp.int32)
     flush_recipients = jnp.where(
         flusher_mask.any(), n_member_now, 0).astype(jnp.int32)
+    if settings.protocol_variant == "ring":
+        flushers = ring_mod.ring_pair_factor(jnp, flusher_mask)
     mid = mid._replace(pending_deliver=mid.pending_flush,
                        pending_flush=jnp.zeros_like(mid.pending_flush),
                        churn_deliver=mid.churn_flush,
@@ -326,6 +377,13 @@ def step(state: EngineState, faults: EngineFaults, settings: Settings,
             "px1a_recipients", "px1b_senders", "px2a_senders",
             "px2a_recipients", "px2b_senders", "px2b_recipients")}
         px_timers_armed = px_coord_round = zero
+    if fallback is not None and settings.protocol_variant == "ring":
+        # The scripted fast-round votes are broadcast-shaped, so the ring
+        # carries them in two laps like the live vote path; the classic
+        # Paxos phases (1a/1b/2a/2b) are coordinator unicasts/broadcasts
+        # among the quorum and stay dense in both engine and oracle.
+        px_counts["pxvote_senders"] = jnp.where(
+            px_counts["pxvote_senders"] > 0, 2, 0).astype(jnp.int32)
 
     # ---- on-device invariant monitor (static flag; see engine.invariants)
     # Module-attribute call so tests can monkeypatch a spy and prove the

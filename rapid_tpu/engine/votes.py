@@ -62,6 +62,45 @@ def segmented_vote_count(xp, vote_hi, vote_lo, valid, mesh=None):
     return sharding.constrain(out, mesh, c)
 
 
+def scan_vote_count(xp, vote_hi, vote_lo, valid, mesh=None):
+    """i32 [C]: same tally as ``segmented_vote_count``, lowered through
+    an associative scan instead of ``segment_sum``.
+
+    The ring dissemination variant (``rapid_tpu.variants.ring``) counts
+    votes by circulating partial tallies around the static ring-0 order;
+    this kernel is its aggregation core: after the same lexsort, a
+    forward max-scan propagates each run's start index and the count of
+    a run is ``end - start + 1`` — the prefix-sum shape a ring lap
+    lowers to, with no segment scatter. Bit-identical to
+    ``segmented_vote_count`` over every (mask, fingerprint) input;
+    ``tests/test_variants.py`` property-tests the pair.
+    """
+    c = vote_hi.shape[0]
+    invalid = (~valid).astype(xp.uint32)
+    order = xp.lexsort((vote_lo, vote_hi, invalid))
+    shi = vote_hi[order]
+    slo = vote_lo[order]
+    sval = valid[order]
+    prev_differs = xp.ones((c,), bool).at[1:].set(
+        (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1]))
+    idx = xp.arange(c, dtype=xp.int32)
+    # Forward max-scan propagates each run's start index; the mirrored
+    # reverse min-scan propagates its (inclusive) end index. A run's
+    # count is then a prefix-sum difference over the valid mask, so
+    # invalid slots (sorted last, but possibly fingerprint-equal to a
+    # valid run's tail) contribute zero, exactly as segment_sum does.
+    start = jax.lax.associative_scan(
+        xp.maximum, xp.where(prev_differs, idx, -1))
+    next_differs = xp.ones((c,), bool).at[:-1].set(prev_differs[1:])
+    run_end = jax.lax.associative_scan(
+        xp.minimum, xp.where(next_differs, idx, c), reverse=True)
+    csum = xp.cumsum(sval.astype(xp.int32))
+    base = xp.where(start > 0, csum[xp.maximum(start - 1, 0)], 0)
+    counts_sorted = (csum[run_end] - base) * sval.astype(xp.int32)
+    out = xp.zeros((c,), xp.int32).at[order].set(counts_sorted)
+    return sharding.constrain(out, mesh, c)
+
+
 def fast_quorum(xp, n_member):
     """The fast-round quorum as the reference computes it:
     ``N - floor((N-1)/4)``, i.e. ``N - f`` for ``f = floor((N-1)/4)``.

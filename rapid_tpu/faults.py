@@ -690,7 +690,12 @@ def random_adversary_schedule(n: int, seed: int, ticks: int,
 class ScenarioWeights:
     """Sampling weights over the scenario-space kinds of
     ``sample_adversary_schedule``. Zero removes a kind; weights need not
-    normalize. The default mix exercises every kind."""
+    normalize. The default mix exercises every kind.
+
+    Field names are one-to-one with ``SCENARIO_KINDS`` (and, for the
+    latency family, ``DELAY_KINDS``) — asserted by
+    ``tests/test_variants.py`` — so adding a kind means adding a field
+    here, a branch in the sampler, and an entry in the kind table."""
 
     crash: float = 1.0
     partition: float = 1.0
@@ -751,13 +756,31 @@ def sample_adversary_schedule(
         fd_interval: int = 10, ring_depth: int = 4) -> SampledScenario:
     """Seeded scenario-space sampler for Monte-Carlo fleet campaigns.
 
-    Draws a scenario *kind* from ``weights`` and fills in its knobs
-    (burst sizes, partition subsets and healing, flip-flop periods,
-    contested camp splits with explicit fallback delays, latency-family
-    delay/jitter/asymmetry bounded by ``ring_depth``) from the same
-    ``random.Random(seed)`` stream — fully deterministic in ``seed``.
-    Every returned schedule passes ``validate_schedule`` with the given
-    ``ring_depth`` (property-tested in ``tests/test_fleet.py``).
+    Draws a scenario *kind* from ``weights`` — the full kind table is
+    ``SCENARIO_KINDS``, in ``ScenarioWeights`` field order:
+
+    - ``crash``      — one correlated crash burst;
+    - ``partition``  — an isolated subset (sometimes healing mid-run,
+      sometimes with a crash burst on top);
+    - ``flip_flop``  — a periodically flapping link window;
+    - ``contested``  — 2-3 camps proposing conflicting removals with
+      explicit fallback delays (no fast quorum, classic round recovers);
+    - ``churn``      — join/leave traffic (``wants_churn=True``; the
+      churn schedule itself lives in ``engine.churn.ChurnSchedule``,
+      outside the ``AdversarySchedule`` surface), sometimes under a
+      light crash;
+    - ``delay`` / ``jitter`` / ``slow_asym`` — the latency family
+      (``DELAY_KINDS``): fixed slow subsets, bounded per-message jitter,
+      and asymmetric slow links, all bounded by ``ring_depth`` and paired
+      with a crash burst so each regime exercises a view change.
+
+    Knob fills (burst sizes, subsets, periods, camp splits, delay bounds)
+    come from the same ``random.Random(seed)`` stream — fully
+    deterministic in ``seed``. Every returned schedule passes
+    ``validate_schedule`` with the given ``ring_depth`` (property-tested
+    in ``tests/test_fleet.py``). ``tests/test_variants.py`` asserts the
+    ``ScenarioWeights`` field names match ``SCENARIO_KINDS`` so this
+    table cannot drift from the sampler again.
     ``random_adversary_schedule`` above is the fixed crash+partition mix
     the adversary tests pin; this sampler is the campaign-facing superset.
     """

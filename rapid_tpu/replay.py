@@ -103,13 +103,18 @@ def replay_member(payload: Dict[str, object], dispatch: int,
             raise ValueError(
                 f"campaign block lacks {key!r} — replay needs a "
                 "schema >= 8 payload (re-run the campaign on this tree)")
+    # The wire protocol is campaign identity (schema v11): replaying a
+    # ring/hier campaign on the reference engine would fold different
+    # message counts. Pre-v11 payloads default to the reference.
+    protocol_variant = str(camp.get("protocol_variant", "rapid"))
     cfg = campaign_mod.CampaignConfig(
         clusters=camp["clusters"], n=camp["n"], ticks=camp["ticks"],
         seed=camp["seed"], fleet_size=camp["fleet_size"],
         headroom=camp["headroom"],
         weights=ScenarioWeights(**camp["weights"]),
         per_receiver=camp["per_receiver"]["enabled"],
-        flight_recorder=int(camp.get("flight_recorder") or 0))
+        flight_recorder=int(camp.get("flight_recorder") or 0),
+        protocol_variant=protocol_variant)
 
     # The deterministic chain, replayed verbatim from run_campaign:
     # sample -> route -> pools -> chunk plan. Same seed, same plan.
@@ -120,6 +125,8 @@ def replay_member(payload: Dict[str, object], dispatch: int,
     rx_kernel = camp["per_receiver"].get("rx_kernel", "xla")
     if rx_kernel != "xla":
         base = base.with_(rx_kernel=rx_kernel)
+    if protocol_variant != "rapid":
+        base = base.with_(protocol_variant=protocol_variant)
     c = cfg.n + cfg.headroom
     settings = base.with_(capacity=c)
     rx_settings = base.with_(capacity=cfg.n)
@@ -226,7 +233,12 @@ def replay_member(payload: Dict[str, object], dispatch: int,
     if oracle:
         oracle_block = {"run": False, "passed": None, "error": None,
                         "artifact": None}
-        if sc.wants_churn:
+        if protocol_variant != "rapid":
+            oracle_block["error"] = (
+                "oracle referee replays the reference protocol only; "
+                "variant exactness lives in "
+                "engine.diff.run_variant_differential")
+        elif sc.wants_churn:
             oracle_block["error"] = ("oracle referee replays fault "
                                      "surfaces only; churn members are "
                                      "ineligible")

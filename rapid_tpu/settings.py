@@ -81,6 +81,24 @@ class Settings:
     #              mode off-TPU so CI exercises it bit-for-bit.
     rx_kernel: str = "xla"
 
+    # Dissemination/consensus protocol variant (``rapid_tpu.variants``).
+    # Static — flipping it retraces:
+    #   "rapid" — the paper's all-to-all alert/vote fan-out; the traced
+    #             jaxpr is byte-identical to the pre-knob engine (pinned
+    #             like ``rx_kernel``).
+    #   "ring"  — transport-only variant: vote tallies and cut-report
+    #             delivery lower through the static ring-0 order
+    #             (segmented scans / permutation gathers) and message
+    #             counts become O(N) per tick (one lap up, one lap
+    #             down). Decisions, config ids and protocol state stay
+    #             bit-identical to "rapid".
+    #   "hier"  — two-level hierarchical consensus: slots hash into
+    #             G = max(2, isqrt(capacity)) seeded groups; an announce
+    #             decides only when >= fast_quorum(G_nonempty) groups
+    #             each reach their intra-group fast quorum. The classic
+    #             Paxos fallback instance is untouched.
+    protocol_variant: str = "rapid"
+
     # Width of the packed per-slot epoch deltas (8 or 16). Deltas that
     # would saturate the narrow dtype are clamped AND flagged
     # (``receiver.FLAG_EPOCH_DELTA_SAT``), so the fallback is explicit:
@@ -140,6 +158,10 @@ class Settings:
             raise ValueError(
                 f"rx_kernel must be one of 'xla', 'packed', 'pallas', "
                 f"got {self.rx_kernel!r}")
+        if self.protocol_variant not in ("rapid", "ring", "hier"):
+            raise ValueError(
+                f"protocol_variant must be one of 'rapid', 'ring', "
+                f"'hier', got {self.protocol_variant!r}")
         if self.stream_chunk_ticks < 1:
             raise ValueError(
                 f"stream_chunk_ticks must be >= 1, got "

@@ -482,13 +482,93 @@ def receiver_memory_block(settings, n: int = 64,
     }
 
 
+#: Working-set budget of the protocol-variant block (bytes): sizes whose
+#: dense O(N^2) reference kernel would exceed this are recorded as
+#: structured refusals instead of being attempted — the block's point is
+#: that the ring aggregation stays inside a laptop-class budget at
+#: 1M nodes while the dense broadcast cannot.
+VARIANT_BUDGET_BYTES = 2 << 30
+
+
+def variant_sweep_block(settings, sizes: Sequence[int],
+                        repeats: int = 3, seed: int = 0,
+                        budget_bytes: int = VARIANT_BUDGET_BYTES
+                        ) -> Dict[str, object]:
+    """Ring-variant aggregation kernel vs the dense broadcast, per size.
+
+    ``ring_aggregate`` is the wire kernel of ``protocol_variant="ring"``
+    (``engine.votes.scan_vote_count`` under the ring permutation and its
+    inverse — the exact composition ``variants.ring.ring_count_fast_round``
+    lowers): O(C) state, O(C log C) work, so it *measures* at 1M nodes.
+    ``dense_broadcast`` is the reference all-to-all it replaces — the
+    ``[C, C]`` pairwise delivery matrix every member's vote fans out
+    over. Its footprint is ``C^2`` bytes; any size where that exceeds
+    ``budget_bytes`` lands in ``refusals`` with the required bytes and
+    the reason, and the kernel is never lowered — a documented refusal,
+    not an OOM.
+    """
+    import jax.numpy as jnp
+
+    from rapid_tpu.engine import votes as votes_mod
+
+    kernels: List[Dict[str, object]] = []
+    refusals: List[Dict[str, object]] = []
+    for n in sizes:
+        rng = np.random.default_rng(seed ^ n)
+        # Realistic vote occupancy: a few contending fingerprints over
+        # most slots valid, like a contested announce mid-flight.
+        pool = rng.integers(0, 2**64, 4, dtype=np.uint64)
+        fps = pool[rng.integers(0, len(pool), n)]
+        hi = jnp.asarray((fps >> np.uint64(32)).astype(np.uint32))
+        lo = jnp.asarray((fps & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        valid = jnp.asarray(rng.random(n) < 0.95)
+        perm_np = rng.permutation(n).astype(np.int32)
+        perm = jnp.asarray(perm_np)
+        inv = jnp.asarray(np.argsort(perm_np).astype(np.int32))
+
+        def ring_aggregate(hi, lo, valid, perm, inv):
+            counts = votes_mod.scan_vote_count(
+                jnp, hi[perm], lo[perm], valid[perm])[inv]
+            return counts.max(), valid.sum()
+
+        kc = measure_kernel("ring_aggregate", ring_aggregate,
+                            (hi, lo, valid, perm, inv), repeats=repeats)
+        kernels.append({**kc.as_dict(), "n": n})
+
+        dense_bytes = n * n  # the [C, C] bool delivery matrix
+        if dense_bytes > budget_bytes:
+            refusals.append({
+                "kernel": "dense_broadcast",
+                "n": n,
+                "bytes_required": dense_bytes,
+                "budget_bytes": budget_bytes,
+                "reason": (f"[C, C] pairwise delivery matrix needs "
+                           f"{dense_bytes} bytes at C={n}, over the "
+                           f"{budget_bytes}-byte budget — the dense "
+                           f"reference cannot run at this size"),
+            })
+            continue
+
+        def dense_broadcast(hi, valid):
+            seen = valid[:, None] & valid[None, :]
+            return seen.sum(axis=0).max(), valid.sum()
+
+        kc = measure_kernel("dense_broadcast", dense_broadcast,
+                            (hi, valid), repeats=repeats)
+        kernels.append({**kc.as_dict(), "n": n})
+    return {"sizes": list(sizes), "budget_bytes": budget_bytes,
+            "kernels": kernels, "refusals": refusals}
+
+
 def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
                      seed: int = 0, warmup_ticks: int = 8,
                      include_fallback: bool = True,
                      multichip: bool = True,
                      multichip_devices: int = 8,
                      receiver_memory: bool = True,
-                     receiver_n: int = 64) -> Dict[str, object]:
+                     receiver_n: int = 64,
+                     variant_sizes: Optional[Sequence[int]] = None
+                     ) -> Dict[str, object]:
     """The ``--profile-sweep`` artifact: per-N kernel costs plus the
     wall-clock-dominant kernel per N (the pjit-sharding gate input).
 
@@ -498,7 +578,10 @@ def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
     consumers can tell "not measured" from "not present". The
     ``receiver_memory`` block (same null-when-skipped convention) sizes
     the per-receiver fleet step at small and campaign-scale fleet
-    widths.
+    widths. ``variant_sizes`` (schema v11, same null-when-skipped
+    convention) profiles the ring-variant aggregation kernel against
+    the dense broadcast at the listed sizes — over-budget dense sizes
+    become documented refusals (``variant_sweep_block``).
     """
     import jax
 
@@ -520,6 +603,9 @@ def dominance_report(sizes: Sequence[int], settings, repeats: int = 5,
             seed=seed, warmup_ticks=warmup_ticks) if multichip else None,
         "receiver_memory": receiver_memory_block(
             settings, n=receiver_n, seed=seed) if receiver_memory
+        else None,
+        "variants": variant_sweep_block(
+            settings, variant_sizes, seed=seed) if variant_sizes
         else None,
     }
 
@@ -547,6 +633,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--multichip-devices", type=int, default=8,
                         help="mesh width for the multichip block "
                              "(default 8; needs that many jax devices)")
+    parser.add_argument("--variant-sizes", type=int, nargs="+",
+                        default=None, metavar="N",
+                        help="also profile the ring-variant aggregation "
+                             "kernel vs the dense broadcast at these "
+                             "sizes; dense sizes over the memory budget "
+                             "are recorded as refusals, never attempted "
+                             "(default: skip the block)")
     parser.add_argument("--merge-multichip", type=str, default=None,
                         metavar="REPORT",
                         help="take the multichip block from an existing "
@@ -573,7 +666,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                          and args.merge_multichip is None),
                               multichip_devices=args.multichip_devices,
                               receiver_memory=not args.no_receiver_memory,
-                              receiver_n=args.receiver_n)
+                              receiver_n=args.receiver_n,
+                              variant_sizes=args.variant_sizes)
     if args.merge_multichip is not None:
         with open(args.merge_multichip) as fh:
             report["multichip"] = json.load(fh).get("multichip")

@@ -25,7 +25,13 @@ _OPT_INT = (int, type(None))
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
 #: should fail loudly, not drift).
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
+
+#: Protocol variants a campaign/replay payload may record
+#: (``rapid_tpu.variants.VARIANTS``; kept literal here — the schema
+#: module stays import-light — and pinned against the package tuple by
+#: ``tests/test_variants.py``/``tests/test_telemetry.py``).
+PROTOCOL_VARIANTS = ("rapid", "ring", "hier")
 
 #: Fold semantics of every RunSummary gauge when aggregated over a fleet
 #: axis (``telemetry.metrics.merge_summaries``). "total" gauges sum
@@ -179,8 +185,13 @@ RECEIVER_FLEET_ENTRY_SPEC = {
 #: ``fleet_size``/``per_receiver.enabled`` they reconstruct every
 #: sampled schedule and the dispatch plan bit-exactly, which is what
 #: ``python -m rapid_tpu.replay`` consumes) and the ``triage`` block.
+#: Schema v11 adds ``protocol_variant`` (the wire protocol every member
+#: ran — replay re-derives the variant from this field alone) and the
+#: optional ``tournament`` block (present only on A/B tournament runs,
+#: ``campaign.run_tournament``).
 CAMPAIGN_SPEC = {
     "seed": (int,),
+    "protocol_variant": (str,),
     "clusters": (int,),
     "n": (int,),
     "ticks": (int,),
@@ -288,6 +299,57 @@ CAMPAIGN_POOL_SPEC = {
     "kinds": (dict,),
     "shape": (dict,),
 }
+
+#: A/B tournament block (schema v11) under ``campaign.tournament``,
+#: present only when the payload came from ``campaign.run_tournament``:
+#: every sampled member ran once per listed variant over identical
+#: schedules/identities. All fields are seed-deterministic, so
+#: ``scripts/bench_compare.py``'s exact campaign diff gates the block.
+TOURNAMENT_SPEC = {
+    "variants": (list,),
+    "clusters": (int,),
+    "per_variant": (dict,),
+    "win_loss": (dict,),
+}
+
+#: One per-variant tournament row: decide counts, classic-fallback
+#: member count, total wire messages, and the nearest-rank
+#: decide-tick tail (one DISTRIBUTION_SPEC block).
+TOURNAMENT_VARIANT_SPEC = {
+    "decided": (int,),
+    "fallback_members": (int,),
+    "total_messages": (int,),
+    "decide_ticks": (dict,),
+}
+
+#: Protocol-variant kernel block of the dominance report (schema v11,
+#: ``rapid_tpu.telemetry.profile.variant_sweep_block``). Like
+#: ``multichip``, the top-level ``variants`` key may be ``null``
+#: ("not measured"); when present it carries the measured ring
+#: aggregation kernels plus the documented dense-broadcast refusals —
+#: sizes where the O(N^2) reference kernel would exceed the memory
+#: budget are recorded as structured refusals, never attempted.
+VARIANT_SPEC = {
+    "sizes": (list,),
+    "budget_bytes": (int,),
+    "kernels": (list,),
+    "refusals": (list,),
+}
+
+#: One documented refusal of the variant profile block: the kernel that
+#: was *not* run, at which size, the bytes it would have needed against
+#: the budget, and the one-line reason.
+VARIANT_REFUSAL_SPEC = {
+    "kernel": (str,),
+    "n": (int,),
+    "bytes_required": (int,),
+    "budget_bytes": (int,),
+    "reason": (str,),
+}
+
+#: One measured variant kernel entry: a KERNEL_COST_SPEC record plus
+#: the size it ran at.
+VARIANT_KERNEL_SPEC = dict(KERNEL_COST_SPEC, n=(int,))
 
 #: Delay-regime keys the ``delay_regimes`` block may carry (schema v6):
 #: the latency-family scenario kinds plus the delay-free rest of the
@@ -814,10 +876,61 @@ def validate_triage(block, where: str = "triage") -> List[str]:
     return errors
 
 
+def validate_tournament(block, where: str = "tournament") -> List[str]:
+    """Validate one ``campaign.tournament`` A/B block (schema v11)."""
+    errors = _check(block, TOURNAMENT_SPEC, where)
+    if not isinstance(block, dict):
+        return errors
+    raw = block.get("variants")
+    names = [v for v in raw if isinstance(v, str)] \
+        if isinstance(raw, list) else []
+    for v in names:
+        if v not in PROTOCOL_VARIANTS:
+            errors.append(f"{where}.variants: {v!r} is not one of "
+                          f"{'/'.join(PROTOCOL_VARIANTS)}")
+    per = block.get("per_variant")
+    if isinstance(per, dict):
+        for v in names:
+            if v not in per:
+                errors.append(f"{where}.per_variant.{v}: missing")
+        for v, row in per.items():
+            vw = f"{where}.per_variant.{v}"
+            if v not in names:
+                errors.append(f"{vw}: names no tournament variant")
+            errors += _check(row, TOURNAMENT_VARIANT_SPEC, vw)
+            if isinstance(row, dict) \
+                    and isinstance(row.get("decide_ticks"), dict):
+                errors += _check(row["decide_ticks"], DISTRIBUTION_SPEC,
+                                 f"{vw}.decide_ticks")
+    wl = block.get("win_loss")
+    if isinstance(wl, dict):
+        for kind, row in wl.items():
+            kw = f"{where}.win_loss.{kind}"
+            if not isinstance(row, dict):
+                errors.append(f"{kw}: expected an object, "
+                              f"got {type(row).__name__}")
+                continue
+            for key in names + ["tie"]:
+                if key not in row:
+                    errors.append(f"{kw}.{key}: missing")
+            for key, count in row.items():
+                if not isinstance(count, int) or isinstance(count, bool):
+                    errors.append(f"{kw}.{key}: expected int, "
+                                  f"got {type(count).__name__}")
+    return errors
+
+
 def validate_campaign(block, where: str = "campaign") -> List[str]:
     errors = _check(block, CAMPAIGN_SPEC, where)
     if not isinstance(block, dict):
         return errors
+    pv = block.get("protocol_variant")
+    if isinstance(pv, str) and pv not in PROTOCOL_VARIANTS:
+        errors.append(f"{where}.protocol_variant: {pv!r} is not one of "
+                      f"{'/'.join(PROTOCOL_VARIANTS)}")
+    if "tournament" in block:
+        errors += validate_tournament(block["tournament"],
+                                      f"{where}.tournament")
     kinds = block.get("scenario_kinds")
     if isinstance(kinds, dict):
         for kind, count in kinds.items():
@@ -1324,6 +1437,16 @@ def validate_profile_payload(payload, where: str = "payload") -> List[str]:
             for j, entry in enumerate(rm.get("fleets") or []):
                 errors += _check(entry, RECEIVER_FLEET_ENTRY_SPEC,
                                  f"{where}.receiver_memory.fleets[{j}]")
+    vb = payload.get("variants")
+    if vb is not None:  # null means "not measured", which is valid
+        errors += _check(vb, VARIANT_SPEC, f"{where}.variants")
+        if isinstance(vb, dict):
+            for j, kc in enumerate(vb.get("kernels") or []):
+                errors += _check(kc, VARIANT_KERNEL_SPEC,
+                                 f"{where}.variants.kernels[{j}]")
+            for j, rf in enumerate(vb.get("refusals") or []):
+                errors += _check(rf, VARIANT_REFUSAL_SPEC,
+                                 f"{where}.variants.refusals[{j}]")
     return errors
 
 

@@ -1,0 +1,38 @@
+"""Protocol-variant lab: dissemination/consensus variants of the engine.
+
+The paper's protocol broadcasts alerts and fast-round votes all-to-all —
+O(N^2) messages per exchange, the wall between the 100k profile sweeps
+and the 1M-node target. This package holds the variant layer selected by
+the static ``Settings.protocol_variant`` knob:
+
+``"rapid"``
+    The reference protocol. The knob's default; ``engine/step.py`` must
+    trace a byte-identical jaxpr under it (pinned by
+    ``tests/test_variants.py`` like the ``rx_kernel`` knob).
+
+``"ring"`` (:mod:`rapid_tpu.variants.ring`)
+    Transport-only: vote tallies and cut-report delivery lower through
+    the static ring-0 permutation (Ring-Paxos-style circulation — one
+    lap to aggregate, one lap to disseminate), so each broadcast-shaped
+    exchange costs 2N messages instead of S*N. Decisions, config ids
+    and every protocol state bit stay identical to "rapid"; only the
+    logged message factors — and the variant-aware oracle's counts —
+    change.
+
+``"hier"`` (:mod:`rapid_tpu.variants.hier`)
+    Two-level hierarchical consensus (Fast-Raft-style): slots hash into
+    G = max(2, isqrt(capacity)) seeded groups, an announce decides only
+    when >= fast_quorum(G_nonempty) groups each reach their intra-group
+    fast quorum, and the verdict round among group aggregators is
+    counted as an inter-group all-to-all. The classic-Paxos fallback
+    instance is reused verbatim as the top-level settle path.
+
+:mod:`rapid_tpu.variants.oracle` hosts the variant-aware transform of
+the host oracle's per-tick counters, which
+``engine.diff.run_variant_differential`` compares bit-for-bit against
+the engine's expanded StepLog factors.
+"""
+from __future__ import annotations
+
+#: Every value ``Settings.protocol_variant`` accepts, default first.
+VARIANTS = ("rapid", "ring", "hier")

@@ -1,0 +1,121 @@
+"""Two-level hierarchical consensus: seeded groups + inter-group verdict.
+
+Fast Raft keeps fast-path quorums small by partitioning nodes into
+groups: each group runs its own fast quorum and a compact inter-group
+instance settles the global order. Mapped onto the batched engine:
+
+- every slot hashes into one of ``G = max(2, isqrt(capacity))`` seeded
+  groups (``group_ids`` — identity-derived, so the partition is stable
+  across configurations and reproducible host-side);
+- a fast-round announce decides only when at least
+  ``fast_quorum(G_nonempty)`` groups each gather intra-group fast
+  quorums over their own members (``hier_count_fast_round``);
+- message accounting (``hier_exchange_messages``): one intra-group vote
+  per voter, an all-to-all verdict round among the live group
+  aggregators (``G_live^2``), and one relayed verdict per member.
+
+The hierarchical decide rule is strictly harder than the flat one (a
+skewed crash burst can kill one group's quorum while the global 3/4
+quorum still holds), so the differential harness only admits scenarios
+where both rules agree — ``np_hier_decide`` is the independent host
+twin that certifies the envelope. When the fast path fails, the classic
+Paxos fallback instance is reused verbatim as the top-level settle
+path, so contested scenarios are count-identical to "rapid".
+"""
+from __future__ import annotations
+
+import math
+
+from rapid_tpu import hashing
+from rapid_tpu.engine import votes
+
+#: Seed for the identity -> group hash ("hier" in ASCII).
+HIER_GROUP_SEED = 0x68696572
+
+
+def hier_group_count(capacity: int) -> int:
+    """Static number of groups G for a given slot capacity.
+
+    sqrt(C) balances intra-group quorum size against the G^2 verdict
+    round; the floor of 2 keeps the two-level structure meaningful (and
+    ``fast_quorum`` well-defined) at toy sizes.
+    """
+    return max(2, math.isqrt(capacity))
+
+
+def group_ids(xp, uid_hi, uid_lo, n_groups):
+    """i32 [C]: each slot's group, hashed from its identity.
+
+    Identity-derived (not slot-index-derived) so the host oracle can
+    recompute the partition from endpoint UUIDs alone, and so the
+    partition survives slot renumbering across configurations.
+    """
+    _, lo = hashing.hash64_limbs(xp, uid_hi, uid_lo, seed=HIER_GROUP_SEED)
+    return (lo % xp.uint32(n_groups)).astype(xp.int32)
+
+
+def hier_count_fast_round(xp, member, valid, uid_hi, uid_lo, n_groups,
+                          mesh=None):
+    """Returns (decided, tally): the two-level fast-round decide rule.
+
+    ``member`` masks the announce-time membership (group sizes), ``valid``
+    the delivered votes. Per group g: m_g members, v_g valid votes; the
+    group reaches quorum when ``v_g >= fast_quorum(m_g)`` and is
+    non-empty. The announce decides when the number of quorate groups
+    reaches ``fast_quorum(#non-empty groups)``. ``tally`` is the total
+    delivered votes — same gauge the dense path logs as winner_count
+    (the crash-fault pipeline is single-proposal, so the winner's count
+    is the valid total).
+    """
+    del mesh  # [G] reductions are tiny; no re-constraint needed.
+    gid = group_ids(xp, uid_hi, uid_lo, n_groups)
+    onehot = gid[None, :] == xp.arange(n_groups, dtype=xp.int32)[:, None]
+    m_g = (onehot & member[None, :]).sum(axis=1).astype(xp.int32)
+    v_g = (onehot & valid[None, :]).sum(axis=1).astype(xp.int32)
+    group_yes = (v_g >= votes.fast_quorum(xp, m_g)) & (m_g > 0)
+    n_live = (m_g > 0).sum().astype(xp.int32)
+    decided = group_yes.sum().astype(xp.int32) >= votes.fast_quorum(
+        xp, n_live)
+    tally = valid.sum().astype(xp.int32)
+    return decided, tally
+
+
+def np_hier_decide(np, member_mask, valid_mask, uid_hi, uid_lo, n_groups):
+    """Host twin of ``hier_count_fast_round``'s decide bit, via bincount.
+
+    Written against numpy (passed in as ``np``) with an independent
+    reduction (``bincount`` instead of the one-hot matmul) so the
+    differential harness's envelope check does not share code with the
+    engine kernel it certifies.
+    """
+    gid = np.asarray(
+        group_ids(np, np.asarray(uid_hi, np.uint32),
+                  np.asarray(uid_lo, np.uint32), n_groups))
+    m_g = np.bincount(gid, weights=np.asarray(member_mask, np.int64),
+                      minlength=n_groups).astype(np.int64)
+    v_g = np.bincount(gid, weights=np.asarray(valid_mask, np.int64),
+                      minlength=n_groups).astype(np.int64)
+    quorum_g = m_g - (m_g - 1) // 4
+    group_yes = (v_g >= quorum_g) & (m_g > 0)
+    n_live = int((m_g > 0).sum())
+    need = n_live - (n_live - 1) // 4
+    return int(group_yes.sum()) >= need
+
+
+def hier_exchange_messages(xp, voters, relay_targets, uid_hi, uid_lo,
+                           n_groups):
+    """i32 scalar: messages for one hierarchical fast-round exchange.
+
+    ``voters`` masks the slots casting intra-group votes (one unicast to
+    their group aggregator each), the aggregators of the G_live groups
+    holding at least one voter exchange verdicts all-to-all
+    (``G_live^2``), and the settled verdict is relayed to every slot in
+    ``relay_targets``. The [G, C] broadcast keeps this xp-agnostic so
+    ``variants.oracle`` reuses it verbatim with numpy.
+    """
+    gid = group_ids(xp, uid_hi, uid_lo, n_groups)
+    onehot = gid[None, :] == xp.arange(n_groups, dtype=xp.int32)[:, None]
+    g_live = (onehot & voters[None, :]).any(axis=1).sum().astype(xp.int32)
+    n_votes = voters.sum().astype(xp.int32)
+    n_relay = relay_targets.sum().astype(xp.int32)
+    return n_votes + g_live * g_live + n_relay

@@ -1,0 +1,57 @@
+"""Ring dissemination: O(N) vote counting over the static ring-0 order.
+
+Ring Paxos observes that a fixed ring sustains near-wire atomic-broadcast
+throughput because every message makes exactly one lap instead of S*N
+unicasts. The engine already carries the per-configuration ring-0
+permutation (``state.ring_order`` / ``state.ring_rank`` — mutual
+inverses, see ``engine.state``), so the variant is transport-only:
+
+- vote tallies enter the ring in ring-0 position order, accumulate as a
+  segmented scan along the lap (``votes.scan_vote_count``), and are read
+  back out at each slot's rank — a permutation round trip that is the
+  identity on values, so decisions and config ids are bit-identical to
+  "rapid";
+- cut-report delivery circulates the same way
+  (``cut.ring_deliver_reports``);
+- the per-tick message factors collapse to "one lap up, one lap down":
+  a broadcast-shaped exchange costs 2 sender-units * N recipients
+  instead of S * N. ``variants.oracle`` applies the same accounting to
+  the host oracle so ``run_variant_differential`` checks the counts
+  exactly.
+"""
+from __future__ import annotations
+
+from rapid_tpu.engine import votes
+
+
+def ring_count_fast_round(xp, state, vote_hi, vote_lo, valid, n_member,
+                          mesh=None):
+    """``votes.count_fast_round`` lowered through the ring-0 permutation.
+
+    Votes are gathered into ring-lap order (``ring_order[:, 0]``), tallied
+    with the associative-scan kernel (the shape a circulating partial
+    tally lowers to), and scattered back through the inverse permutation
+    (``ring_rank[:, 0]``). Permuting the inputs permutes the per-slot
+    counts identically, and the quorum reductions are permutation
+    invariant — bit-identical to the dense path.
+    """
+    perm = state.ring_order[:, 0]
+    inv = state.ring_rank[:, 0]
+    counts = votes.scan_vote_count(
+        xp, vote_hi[perm], vote_lo[perm], valid[perm], mesh=mesh)[inv]
+    quorum = votes.fast_quorum(xp, n_member)
+    winner_count = counts.max()
+    total = valid.sum().astype(xp.int32)
+    return (total >= quorum) & (winner_count >= quorum), winner_count
+
+
+def ring_pair_factor(xp, any_mask):
+    """i32 scalar: the ring variant's sender factor for one exchange.
+
+    Whenever any slot in ``any_mask`` has something to send, the exchange
+    costs exactly one aggregation lap plus one dissemination lap — a
+    sender factor of 2, independent of how many slots contribute. The
+    recipient factor (N) is unchanged, giving the 2N-per-tick count the
+    variant-aware oracle reproduces.
+    """
+    return xp.where(any_mask.any(), 2, 0).astype(xp.int32)

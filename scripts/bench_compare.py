@@ -20,7 +20,15 @@ accepted: runs are matched by ``n`` against the committed
 ``benchmarks/dominance_report.json`` (picked automatically when
 ``--baseline`` is left at its default) and per-kernel wall-clock medians
 diff warn-only — wall time is machine-dependent, so only a K mismatch or
-a kernel disappearing from the sweep is an error.
+a kernel disappearing from the sweep is an error. The sweep's
+``variants`` block (schema v11, ``--variant-sizes``) splits the same
+way: the config row and the dense-broadcast refusal arithmetic diff
+exactly, variant kernel walls warn.
+
+Campaign payloads carry the whole ``campaign`` block — including a
+``tournament`` block when present — through the exact union-of-keys
+diff, so the committed ``benchmarks/campaign_tournament.json`` gates a
+same-config rerun bit-for-bit (``--baseline`` selects it).
 
 Usage (wired into ``scripts/tier1.sh``)::
 
@@ -305,6 +313,58 @@ def compare_profile_sweeps(current: Dict, baseline: Dict,
                         f"{where}.{key}: {cur_v} is {up:.0f}% above "
                         f"baseline {base_v} (tolerance "
                         f"{wall_tolerance * 100:.0f}%)")
+
+    # Protocol-variant block (schema v11): same null-tolerance as
+    # multichip (the tier-1 smoke skips it, the committed sweep carries
+    # the 1M ring entry). The config row and the refusals — which sizes
+    # the dense broadcast was *refused* at, and the bytes arithmetic
+    # behind each refusal — are deterministic and diff exactly: a
+    # refusal silently disappearing means someone started materializing
+    # the O(N^2) matrix again. Kernel wall medians warn like every other
+    # profiled kernel.
+    cur_vb = current.get("variants")
+    base_vb = baseline.get("variants")
+    if isinstance(cur_vb, dict) and isinstance(base_vb, dict):
+        for key in ("sizes", "budget_bytes"):
+            if cur_vb.get(key) != base_vb.get(key):
+                errors.append(
+                    f"payload.variants.{key}: config mismatch (current "
+                    f"{cur_vb.get(key)!r} vs baseline {base_vb.get(key)!r})"
+                    f" — regenerate with --update-baseline")
+        cur_ref = {(r.get("kernel"), r.get("n")): r
+                   for r in cur_vb.get("refusals", [])}
+        base_ref = {(r.get("kernel"), r.get("n")): r
+                    for r in base_vb.get("refusals", [])}
+        for key in sorted(set(base_ref) - set(cur_ref)):
+            errors.append(f"payload.variants.refusals: {key[0]!r} at "
+                          f"n={key[1]} refused in baseline but attempted "
+                          f"in current sweep")
+        for key in sorted(set(cur_ref) & set(base_ref)):
+            for field in ("bytes_required", "budget_bytes"):
+                if cur_ref[key].get(field) != base_ref[key].get(field):
+                    errors.append(
+                        f"payload.variants.refusals[{key[0]}, n={key[1]}]"
+                        f".{field}: {cur_ref[key].get(field)!r} != "
+                        f"baseline {base_ref[key].get(field)!r}")
+        base_vk = {(k.get("kernel"), k.get("n")): k
+                   for k in base_vb.get("kernels", [])}
+        for k in cur_vb.get("kernels", []):
+            ref = (k.get("kernel"), k.get("n"))
+            base_k = base_vk.get(ref)
+            where = f"payload.variants.kernels[{ref[0]}, n={ref[1]}]"
+            if base_k is None:
+                warnings.append(f"{where}: not in baseline")
+                continue
+            cur_w = k.get("wall_median_s")
+            base_w = base_k.get("wall_median_s")
+            if isinstance(cur_w, (int, float)) and \
+                    isinstance(base_w, (int, float)) and base_w > 0 and \
+                    cur_w > base_w * (1.0 + wall_tolerance):
+                up = 100.0 * (cur_w / base_w - 1.0)
+                warnings.append(
+                    f"{where}.wall_median_s: {cur_w:.3e} is {up:.0f}% "
+                    f"above baseline {base_w:.3e} (tolerance "
+                    f"{wall_tolerance * 100:.0f}%)")
     return errors, warnings
 
 

@@ -27,6 +27,16 @@ its last rate and the payload flush). Healthy sweeps contribute the
 knee columns — the largest stable target in events/sec and the
 windowed p99 ticks-to-view-change measured at that knee.
 
+``TOURNAMENT_rNN.json`` records (capturing ``python -m
+rapid_tpu.campaign --tournament V1,V2``) again follow the tail
+contract: the campaign CLI flushes the full payload as its last
+stdout line, and a tournament round's payload must carry the
+``campaign.tournament`` block. Healthy rounds contribute one line per
+variant — decided members, p99 decide tick and total protocol
+messages — plus the per-kind win/loss ledger, so a variant regressing
+against the reference protocol shows up as a trend, not just a
+one-off artifact diff.
+
 Dead records are the whole point: a round whose ``tail`` is empty or
 whose ``parsed`` is null means the bench ran but its output was lost —
 historically a wall-budget kill with nothing flushed (``bench.py`` now
@@ -295,6 +305,65 @@ def _fold_loadsweep(path: str) -> Dict[str, object]:
     return row
 
 
+def _fold_tournament(path: str) -> Dict[str, object]:
+    """One TOURNAMENT_rNN.json capture record -> a trend row.
+
+    Tournament captures mirror the soak ones (``{n, rc, tail}``) but
+    the last stdout line must be a campaign payload whose ``campaign``
+    block carries ``tournament``. A round whose tail ends in anything
+    else *lost its final payload* and is flagged like a lost heartbeat.
+    Healthy rounds fold one entry per variant (decided count, p99
+    decide tick, total messages) plus the per-kind win/loss ledger.
+    """
+    row: Dict[str, object] = {"path": os.path.basename(path),
+                              "round": -1, "rc": None, "dead": True,
+                              "lost_final_payload": True,
+                              "clusters": None, "variants": {},
+                              "win_loss": None, "problems": []}
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError) as err:
+        row["problems"].append(f"unreadable record: {err}")
+        return row
+    row["round"] = _round_no(path, record)
+    row["rc"] = record.get("rc")
+    tail = record.get("tail")
+    if not isinstance(tail, str) or not tail.strip():
+        row["problems"].append("empty tail — tournament output lost")
+        return row
+    row["dead"] = False
+    try:
+        payload = json.loads(tail.strip().splitlines()[-1])
+    except ValueError:
+        payload = None
+    camp = payload.get("campaign") if isinstance(payload, dict) else None
+    tour = camp.get("tournament") if isinstance(camp, dict) else None
+    if not isinstance(tour, dict):
+        row["problems"].append(
+            "lost final payload — tail does not end in a campaign "
+            "payload with a tournament block")
+        return row
+    row["lost_final_payload"] = False
+    row["clusters"] = tour.get("clusters")
+    row["win_loss"] = tour.get("win_loss")
+    per_variant = tour.get("per_variant")
+    if isinstance(per_variant, dict):
+        for name, block in sorted(per_variant.items()):
+            if not isinstance(block, dict):
+                continue
+            ticks = block.get("decide_ticks")
+            row["variants"][name] = {
+                "decided": block.get("decided"),
+                "total_messages": block.get("total_messages"),
+                "decide_p99": _rate(ticks, "p99")
+                if isinstance(ticks, dict) else None}
+    if not row["variants"]:
+        row["problems"].append("tournament block has no per-variant "
+                               "entries")
+    return row
+
+
 def _fold_multichip(path: str) -> Dict[str, object]:
     row: Dict[str, object] = {"path": os.path.basename(path),
                               "round": -1, "rc": None, "ok": None,
@@ -351,6 +420,9 @@ def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
     sweep_rows = [_fold_loadsweep(p) for p in
                   sorted(glob.glob(os.path.join(directory,
                                                 "LOADSWEEP_r*.json")))]
+    tournament_rows = [_fold_tournament(p) for p in
+                       sorted(glob.glob(os.path.join(
+                           directory, "TOURNAMENT_r*.json")))]
     return {"record": "bench_history",
             "directory": directory,
             "baseline": _baseline_row(baseline_path),
@@ -358,11 +430,14 @@ def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
             "multichip": multichip_rows,
             "soak": soak_rows,
             "load_sweep": sweep_rows,
+            "tournament": tournament_rows,
             "dead_rounds": [r["path"] for r in bench_rows if r["dead"]]
                            + [r["path"] for r in soak_rows
                               if r["dead"] or r["lost_final_heartbeat"]]
                            + [r["path"] for r in sweep_rows
-                              if r["dead"] or r["lost_final_block"]],
+                              if r["dead"] or r["lost_final_block"]]
+                           + [r["path"] for r in tournament_rows
+                              if r["dead"] or r["lost_final_payload"]],
             "partial_rounds": [r["path"] for r in bench_rows
                                if r["partial"]]}
 
@@ -425,6 +500,28 @@ def render(report: Dict[str, object]) -> str:
                      f"{row['n_unstable']} unstable)")
         lines.append(f"load-sweep r{row['round']:02d}: {state} "
                      f"(rc={row['rc']})")
+    for row in report.get("tournament", []):
+        if row["dead"]:
+            state = "DEAD"
+        elif row["lost_final_payload"]:
+            state = "LOST FINAL PAYLOAD"
+        else:
+            cols = []
+            for name, block in sorted(row["variants"].items()):
+                cols.append(
+                    f"{name}: {block['decided']}/{row['clusters']} "
+                    f"decided, p99 {_fmt(block['decide_p99'])}, "
+                    f"{block['total_messages']} msgs")
+            wins = row.get("win_loss") or {}
+            won = {name: sum(kinds.get(name, 0)
+                             for kinds in wins.values()
+                             if isinstance(kinds, dict))
+                   for name in list(row["variants"]) + ["tie"]}
+            cols.append("wins " + "/".join(
+                f"{name}={won[name]}" for name in sorted(won)))
+            state = "; ".join(cols)
+        lines.append(f"tournament r{row['round']:02d}: {state} "
+                     f"(rc={row['rc']})")
     return "\n".join(lines)
 
 
@@ -445,13 +542,16 @@ def main(argv=None) -> int:
 
     report = build_report(args.dir, args.baseline)
     if not report["rounds"] and not report["multichip"] \
-            and not report["soak"] and not report["load_sweep"]:
+            and not report["soak"] and not report["load_sweep"] \
+            and not report["tournament"]:
         print(f"bench_history: no BENCH_r*/MULTICHIP_r*/SOAK_r*/"
-              f"LOADSWEEP_r* records under {args.dir}", file=sys.stderr)
+              f"LOADSWEEP_r*/TOURNAMENT_r* records under {args.dir}",
+              file=sys.stderr)
         return 1
     print(render(report))
     for row in (report["rounds"] + report["multichip"]
-                + report["soak"] + report["load_sweep"]):
+                + report["soak"] + report["load_sweep"]
+                + report["tournament"]):
         for problem in row["problems"]:
             print(f"bench_history: WARNING: {row['path']}: {problem}",
                   file=sys.stderr)

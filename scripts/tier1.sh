@@ -478,6 +478,49 @@ if [ "$rc" -eq 0 ]; then
     fi
 fi
 
+# Protocol-variant smoke: the ring and hierarchical variants must prove
+# bit-identity against the variant-aware host oracle at N=64 (a
+# three-crash burst each; assert_identical raises on any divergence in
+# decisions, per-tick message counts or final config ids), and a small
+# two-variant tournament must run every sampled member once per variant
+# over identical schedules and emit a schema-valid payload whose
+# campaign.tournament block carries both variants' decide tails and the
+# per-kind win/loss ledger. Latency kinds are zeroed because variant
+# members run the shared-state engine (per-receiver delivery is
+# reference-protocol-only); the committed 256-member artifact is
+# benchmarks/campaign_tournament.json.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -c '
+from rapid_tpu.engine.diff import run_variant_differential
+for variant in ("ring", "hier"):
+    res = run_variant_differential(64, {3: 5, 17: 5, 40: 7}, 130, variant)
+    res.assert_identical()
+    print(variant, "bit-identical,", res.engine_message_total, "messages")
+' \
+        && timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 8 --fleet-size 8 --n 32 --ticks 160 \
+            --weights delay=0,jitter=0,slow_asym=0 \
+            --tournament rapid,ring \
+            --out /tmp/_t1_tournament.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_tournament.json \
+        && python -c '
+import json, sys
+camp = json.load(open("/tmp/_t1_tournament.json"))["campaign"]
+tour = camp["tournament"]
+ok = (camp["protocol_variant"] == "rapid"
+      and sorted(tour["variants"]) == ["rapid", "ring"]
+      and tour["clusters"] == 8
+      and all(v in tour["per_variant"] for v in tour["variants"])
+      and all(set(tour["variants"]) | {"tie"} <= set(row)
+              for row in tour["win_loss"].values()))
+sys.exit(0 if ok else 1)'; then
+        echo VARIANT_SMOKE=ok
+    else
+        echo VARIANT_SMOKE=failed
+        rc=1
+    fi
+fi
+
 # Multi-chip smoke: the dry-run entrypoint must boot BASELINE config #1
 # on the forced 8-device CPU mesh, run the sharded tick loop, and print
 # a parseable result line with ok=true (three-way bit-identity: sharded

@@ -108,8 +108,8 @@ def test_campaign_is_deterministic_across_dispatches(tiny_payload,
     assert lines[-1]["record"] == "campaign"
 
 
-def test_campaign_payload_passes_schema_v10(tiny_payload):
-    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 10
+def test_campaign_payload_passes_schema_v11(tiny_payload):
+    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 11
     assert tschema.validate_bench_payload(tiny_payload) == []
     camp = tiny_payload["campaign"]
     assert camp["clusters"] == TINY.clusters

@@ -1,0 +1,259 @@
+"""Protocol-variant lab: differentials, kernels, and the rapid jaxpr pin.
+
+The contract (``rapid_tpu.variants``):
+
+- ``run_variant_differential`` is bit-identical — decisions, config ids,
+  per-tick variant-model message counts — against the variant-aware
+  oracle accounting at N=64 and N=256, for both "ring" and "hier", over
+  crash bursts and contested consensus;
+- scenarios where "hier" legitimately behaves differently (skewed crash
+  bursts killing an intra-group quorum) are *rejected* by the envelope
+  check, and the engine really does refuse the view change there;
+- ``protocol_variant="rapid"`` traces a byte-identical jaxpr to the
+  default settings (same discipline as the ``rx_kernel`` knob);
+- the ring tally kernel (``votes.scan_vote_count``) is property-tested
+  bit-identical to ``segmented_vote_count``;
+- ``ScenarioWeights`` field names match the sampler's kind table.
+"""
+import numpy as np
+import jax
+import pytest
+
+import importlib
+
+from rapid_tpu import hashing
+from rapid_tpu.engine import votes as votes_mod
+from rapid_tpu.engine.diff import (default_endpoints, default_node_ids,
+                                   run_variant_differential)
+from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+from rapid_tpu.settings import Settings
+from rapid_tpu.variants import VARIANTS
+from rapid_tpu.variants import hier as hier_mod
+from rapid_tpu.variants.oracle import VariantEnvelopeError
+
+# The engine package re-exports the ``step`` *function*, shadowing the
+# submodule attribute (same workaround as tests/test_fleet.py).
+step_mod = importlib.import_module("rapid_tpu.engine.step")
+
+SETTINGS = Settings()
+
+CRASH_SCENARIOS = {
+    64: ({3: 5, 17: 5, 40: 7}, 130),
+    256: ({5: 11, 100: 13, 200: 15, 250: 19}, 140),
+}
+
+
+def two_way_split(n):
+    """Contested: two camps, no fast quorum, classic round recovers
+    (same scenario family as ``tests/test_fallback_engine.py``)."""
+    values = [[0], [1]]
+    votes = {s: (6, s % 2) for s in range(n)}
+    delays = {s: (10 if s == 0 else 100) for s in range(n)}
+    return values, votes, delays, 30
+
+
+# ---------------------------------------------------------------------------
+# differentials: variant engine vs variant-aware oracle accounting
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [64, 256])
+@pytest.mark.parametrize("variant", ["ring", "hier"])
+def test_variant_differential_crash_burst(variant, n):
+    crashes, ticks = CRASH_SCENARIOS[n]
+    res = run_variant_differential(n, crashes, ticks, variant)
+    res.assert_identical()
+    # the burst really decided, and the variant accounting is in effect:
+    # ring's whole run costs O(N) messages per exchange, so its total is
+    # far below the rapid O(N^2) announce alone
+    assert any(e.kind == "view_change" for e in res.engine_events)
+    assert res.engine_message_total == res.oracle_message_total
+    if variant == "ring":
+        assert res.engine_message_total < n * n
+
+
+@pytest.mark.parametrize("n", [64, 256])
+@pytest.mark.parametrize("variant", ["ring", "hier"])
+def test_variant_differential_contested(variant, n):
+    values, votes, delays, ticks = two_way_split(n)
+    res = run_variant_differential(n, {}, ticks, variant,
+                                   contested=(values, votes, delays))
+    res.assert_identical()
+    assert any(e.kind == "view_change" for e in res.engine_events)
+    # the classic fallback chain ran identically under the variant
+    assert sum(c["phase1a_sent"] for c in res.engine_phase_counters) == n
+
+
+def test_rapid_variant_is_identity():
+    res = run_variant_differential(64, {7: 5}, 130, "rapid")
+    res.assert_identical()
+
+
+def test_contested_rejects_crashes():
+    with pytest.raises(ValueError, match="crash-free"):
+        run_variant_differential(64, {7: 5}, 30, "ring",
+                                 contested=two_way_split(64)[:3])
+
+
+# ---------------------------------------------------------------------------
+# hier envelope: skewed bursts are rejected, and the engine agrees
+# ---------------------------------------------------------------------------
+
+
+def _skewed_burst(n=64):
+    """Crashes that kill two groups' intra-group quorums while the flat
+    3/4 quorum still holds: per failing group g, crash
+    ``(m_g - 1) // 4 + 1`` members."""
+    from rapid_tpu.oracle.membership_view import uid_of
+
+    uids = np.asarray([uid_of(e) for e in default_endpoints(n)], np.uint64)
+    hi = (uids >> np.uint64(32)).astype(np.uint32)
+    lo = (uids & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    n_groups = hier_mod.hier_group_count(n)
+    gid = np.asarray(hier_mod.group_ids(np, hi, lo, n_groups))
+    crashes = {}
+    broken = 0
+    for g in np.argsort(np.bincount(gid, minlength=n_groups)):
+        members = np.nonzero(gid == g)[0]
+        need = (len(members) - 1) // 4 + 1
+        if len(members) == 0 or len(crashes) + need > n - votes_needed(n):
+            continue
+        for s in members[:need]:
+            crashes[int(s)] = 5
+        broken += 1
+        if broken == 2:
+            break
+    assert broken == 2, "could not build a skewed burst at this size"
+    return crashes
+
+
+def votes_needed(n):
+    return n - (n - 1) // 4
+
+
+def test_hier_rejects_skewed_burst():
+    n = 64
+    crashes = _skewed_burst(n)
+    # flat quorum still decides this burst...
+    res = run_variant_differential(n, crashes, 130, "rapid")
+    res.assert_identical()
+    assert any(e.kind == "view_change" for e in res.engine_events)
+    # ...so the scenario is outside the hier envelope and must be
+    # rejected, not silently compared
+    with pytest.raises(VariantEnvelopeError, match="hier envelope"):
+        run_variant_differential(n, crashes, 130, "hier")
+    # and the hier engine really refuses the view change: it announces
+    # the proposal but never decides
+    settings = SETTINGS.with_(protocol_variant="hier")
+    from rapid_tpu.oracle.membership_view import id_fingerprint, uid_of
+
+    endpoints = default_endpoints(n)
+    uids = [uid_of(e) for e in endpoints]
+    id_fp_sum = sum(id_fingerprint(nid)
+                    for nid in default_node_ids(n)) & hashing.MASK64
+    state = init_state(uids, id_fp_sum, settings)
+    faults = crash_faults([crashes.get(s, I32_MAX) for s in range(n)])
+    _, logs = step_mod.simulate(state, faults, 130, settings)
+    assert np.asarray(logs.announce_now).any()
+    assert not np.asarray(logs.decide_now).any()
+
+
+def test_np_hier_decide_matches_device_rule():
+    """The numpy twin and the engine kernel agree over random masks."""
+    rng = np.random.default_rng(7)
+    n = 64
+    n_groups = hier_mod.hier_group_count(n)
+    hi = rng.integers(0, 2**32, n).astype(np.uint32)
+    lo = rng.integers(0, 2**32, n).astype(np.uint32)
+    import jax.numpy as jnp
+
+    for _ in range(50):
+        member = rng.random(n) < rng.uniform(0.3, 1.0)
+        valid = member & (rng.random(n) < rng.uniform(0.3, 1.0))
+        host = hier_mod.np_hier_decide(np, member, valid, hi, lo, n_groups)
+        dev, tally = hier_mod.hier_count_fast_round(
+            jnp, jnp.asarray(member), jnp.asarray(valid),
+            jnp.asarray(hi), jnp.asarray(lo), n_groups)
+        assert bool(dev) == host
+        assert int(tally) == int(valid.sum())
+
+
+# ---------------------------------------------------------------------------
+# ring tally kernel: scan_vote_count == segmented_vote_count, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("c", [64, 256])
+def test_scan_vote_count_matches_segmented(c):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(c)
+    for trial in range(25):
+        # few distinct fingerprints => long tied runs; sprinkle of
+        # full-width randoms => singleton runs and hi-limb ties
+        pool = rng.integers(0, 2**64, rng.integers(1, 6), dtype=np.uint64)
+        fps = pool[rng.integers(0, len(pool), c)]
+        wild = rng.random(c) < 0.2
+        fps = np.where(wild, rng.integers(0, 2**64, c, dtype=np.uint64), fps)
+        if trial % 3 == 0:  # force hi-limb collisions with distinct lo
+            fps = fps & np.uint64(0xFFFFFFFF)
+        hi = jnp.asarray((fps >> np.uint64(32)).astype(np.uint32))
+        lo = jnp.asarray((fps & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        valid = jnp.asarray(rng.random(c) < rng.uniform(0.0, 1.0))
+        ref = votes_mod.segmented_vote_count(jnp, hi, lo, valid)
+        scan = votes_mod.scan_vote_count(jnp, hi, lo, valid)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(scan))
+
+
+# ---------------------------------------------------------------------------
+# the rapid jaxpr pin + knob validation
+# ---------------------------------------------------------------------------
+
+
+def _step_jaxpr(settings):
+    n = 16
+    hi, lo = hashing.np_to_limbs(np.arange(1, n + 1, dtype=np.uint64))
+    hi, lo = hashing.hash64_limbs(np, hi, lo, seed=0xBEEF)
+    uids = hashing.np_from_limbs(hi, lo)
+    state = init_state(uids, id_fp_sum=0, settings=settings)
+    faults = crash_faults([I32_MAX] * n)
+    return str(jax.make_jaxpr(
+        lambda st, fa: step_mod.step(st, fa, settings))(state, faults))
+
+
+def test_rapid_jaxpr_byte_identical_to_default():
+    """variant="rapid" is the default engine, not a near-copy: the traced
+    step must be byte-identical with the knob at its default and set
+    explicitly, while "ring" and "hier" trace different programs."""
+    base = _step_jaxpr(SETTINGS)
+    assert base == _step_jaxpr(SETTINGS.with_(protocol_variant="rapid"))
+    ring = _step_jaxpr(SETTINGS.with_(protocol_variant="ring"))
+    hier = _step_jaxpr(SETTINGS.with_(protocol_variant="hier"))
+    assert ring != base
+    assert hier != base
+    assert ring != hier
+
+
+def test_protocol_variant_validated():
+    with pytest.raises(ValueError, match="protocol_variant"):
+        Settings(protocol_variant="mesh")
+    assert VARIANTS == ("rapid", "ring", "hier")
+    for v in VARIANTS:
+        assert Settings(protocol_variant=v).protocol_variant == v
+
+
+# ---------------------------------------------------------------------------
+# sampler kind table cannot drift from ScenarioWeights again
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_weights_fields_match_kind_table():
+    import dataclasses
+
+    from rapid_tpu.faults import DELAY_KINDS, SCENARIO_KINDS, ScenarioWeights
+
+    fields = tuple(f.name for f in dataclasses.fields(ScenarioWeights))
+    assert fields == SCENARIO_KINDS
+    assert set(DELAY_KINDS) <= set(SCENARIO_KINDS)
+    # items() yields the same names, in the same order
+    assert tuple(k for k, _ in ScenarioWeights().items()) == SCENARIO_KINDS

@@ -430,6 +430,7 @@ def run_streaming(n: int, capacity: int, ticks: int, chunk_ticks: int,
         "ticks_to_first_decide": telemetry["ticks_to_first_decide"],
         "messages_per_view_change": telemetry["messages_per_view_change"],
         "ticks_to_view_change": summary["ticks_to_view_change"],
+        "lineage": summary["lineage"],
         "traffic": summary["traffic"],
         "checkpoint": summary["checkpoint"],
         "live_buffer_bytes": summary["live_buffer_bytes"],

@@ -126,6 +126,7 @@ from rapid_tpu.faults import (DEFAULT_SCENARIO_WEIGHTS, DELAY_KINDS,
                               SCENARIO_KINDS, SampledScenario,
                               ScenarioWeights, sample_adversary_schedule)
 from rapid_tpu.settings import Settings
+from rapid_tpu.telemetry import lineage as lineage_lib
 
 __all__ = ["CampaignConfig", "run_campaign", "run_tournament", "main"]
 
@@ -820,6 +821,10 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
     # [F, T, ...] logs never leave the fold).
     member_meta: List[Dict[str, object]] = []
     dispatch_recs: Dict[int, object] = {}
+    # Per-member lineage span lists, aligned with summaries/member_order
+    # (schema v12): folded at retire time from the same logs the
+    # summaries come from, so the campaign never re-runs anything.
+    lineage_members: List[List[Dict[str, object]]] = []
     anomalies = {"no_decide_by_deadline": 0, "invariant_violations": 0,
                  "envelope_flags": 0}
     rx_dispatches = 0
@@ -969,12 +974,15 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                 summaries.extend(fleet_summaries(logs)[:len(chunk)])
                 cfg_hi = np.asarray(logs.config_hi)[:len(chunk), -1]
                 cfg_lo = np.asarray(logs.config_lo)[:len(chunk), -1]
+                fleet_cols = lineage_lib.engine_phase_columns(logs)
                 for j in range(len(chunk)):
                     cid = int(cfg_hi[j]) << 32 | int(cfg_lo[j])
                     member_meta.append({
                         "dispatch": d, "member_index": j,
                         "mode": mode, "flags": 0,
                         "config_ids": [f"{cid:016x}"]})
+                    lineage_members.append(
+                        lineage_lib.fold_spans(fleet_cols.member(j)))
             else:
                 rx_dispatches += 1
                 for j in range(len(chunk)):
@@ -1002,6 +1010,15 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                     run = receiver_mod.receiver_run_payload(
                         mrs, mlog, cfg.n, cfg.ticks)
                     summaries.append(summarize(run.metrics()))
+                    spans = lineage_lib.fold_spans(
+                        lineage_lib.receiver_phase_columns(mlog))
+                    sched = scenarios[chunk[j]].schedule
+                    if sched.delays:
+                        for sp in spans:
+                            sp["critical_path"] = \
+                                lineage_lib.receiver_critical_path(
+                                    mlog, sp, sched)
+                    lineage_members.append(spans)
             member_order.extend(chunk)
             for s, meta in zip(summaries[-len(chunk):],
                                member_meta[-len(chunk):]):
@@ -1122,6 +1139,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                 "decide_tick": s.ticks_to_first_decide,
                 "total_sent": s.total_sent,
                 "fallback": classic > 0,
+                "lineage_spans": lineage_members[pos],
             })
         rows.sort(key=lambda r: r["member"])
         member_stats_out.extend(rows)
@@ -1140,6 +1158,32 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
                     ex["recorder"] = recorder_mod.recorder_payload(
                         recorder_mod.member_recorder(
                             recs, ex["member_index"]))
+    # Schema v12: exemplars carry their member's lineage span list (null
+    # only for forced spot-check refs that never ran in the fleet).
+    lineage_by_ref = {
+        (meta["dispatch"], meta["member_index"]): spans
+        for meta, spans in zip(member_meta, lineage_members)}
+    for block in triage["classes"].values():
+        for ex in block["exemplars"]:
+            ex["lineage"] = lineage_by_ref.get(
+                (ex["dispatch"], ex["member_index"]))
+
+    # Fleet-wide lineage tails plus per-kind and per-regime breakdowns.
+    kind_spans: Dict[str, List[Dict[str, object]]] = {}
+    regime_spans: Dict[str, List[Dict[str, object]]] = {}
+    for pos, i in enumerate(member_order):
+        kind = scenarios[i].kind
+        regime = kind if kind in DELAY_KINDS else "no_delay"
+        kind_spans.setdefault(kind, []).extend(lineage_members[pos])
+        regime_spans.setdefault(regime, []).extend(lineage_members[pos])
+    lineage_block = lineage_lib.lineage_summary(
+        [sp for spans in lineage_members for sp in spans])
+    lineage_block["by_kind"] = {
+        k: lineage_lib.lineage_summary(v)
+        for k, v in sorted(kind_spans.items())}
+    lineage_block["by_regime"] = {
+        k: lineage_lib.lineage_summary(v)
+        for k, v in sorted(regime_spans.items())}
 
     progress.emit({"record": "campaign", "clusters_total": total,
                    "dispatches": len(timeline),
@@ -1291,6 +1335,7 @@ def run_campaign(cfg: CampaignConfig, *, trace_path: Optional[str] = None,
             "distributions": dists,
             "delay_regimes": delay_regimes,
             "triage": triage,
+            "lineage": lineage_block,
         },
     }
 
@@ -1352,6 +1397,10 @@ def run_tournament(cfg: CampaignConfig, variants: List[str], *,
             "fallback_members": sum(r["fallback"] for r in rows),
             "total_messages": sum(r["total_sent"] for r in rows),
             "decide_ticks": _dist(ticks),
+            # Schema v12: where each variant spends its latency — the
+            # phase-duration tails over every member's lineage spans.
+            "lineage": lineage_lib.lineage_summary(
+                [sp for r in rows for sp in r["lineage_spans"]]),
         }
 
     # Per-kind win/loss: rank each member's variants by

@@ -1144,3 +1144,215 @@ def run_receiver_differential(
         engine_metrics=dev.metrics(),
         oracle_metrics=host.metrics(),
     )
+
+
+# ---------------------------------------------------------------------------
+# lineage differential: phase-attributed span streams, oracle vs engine
+# ---------------------------------------------------------------------------
+
+#: Scenario families the lineage differential covers.
+LINEAGE_FAMILIES = ("steady", "crash_burst", "delay", "contested")
+
+
+@dataclass
+class LineageDiffResult:
+    """Oracle vs engine lineage span streams for one scenario family.
+
+    Lineage spans are *derived* data — the fold runs independently over
+    the oracle's counter/event timeline and the engine's expanded
+    ``StepLog`` factors (or the adversary referee's counter streams for
+    the delay family), and the comparison is the
+    :func:`rapid_tpu.telemetry.lineage.comparable` projection of every
+    span: window boundaries, ``ticks_to_view_change``, the fallback
+    flag, every oracle-observable milestone tick and all five phase
+    durations. Engine-only fields (fallback timer arm ticks, critical
+    path) are excluded by the projection, not fudged to match.
+    """
+
+    family: str
+    n: int
+    n_ticks: int
+    oracle_spans: Dict[str, List[Dict[str, object]]]
+    engine_spans: Dict[str, List[Dict[str, object]]]
+
+    def first_divergence(self) -> Optional[str]:
+        """Human-readable description of the earliest span disagreement,
+        or None when every stream is bit-identical under the comparable
+        projection."""
+        from rapid_tpu.telemetry.lineage import comparable
+
+        for label in sorted(self.oracle_spans):
+            oracle = [comparable(s) for s in self.oracle_spans[label]]
+            engine = [comparable(s) for s in self.engine_spans.get(label, [])]
+            if len(oracle) != len(engine):
+                return (f"{label}: engine has {len(engine)} spans, "
+                        f"oracle has {len(oracle)}")
+            for i, (e, o) in enumerate(zip(engine, oracle)):
+                if e != o:
+                    keys = [k for k in o if e.get(k) != o.get(k)]
+                    return (f"{label}: span {i} differs on {keys}: "
+                            f"engine={e} oracle={o}")
+        return None
+
+    def assert_identical(self) -> None:
+        div = self.first_divergence()
+        if div is not None:
+            raise AssertionError("lineage divergence: " + div)
+
+
+def _lineage_crash_burst(n: int) -> Dict[int, int]:
+    return {max(1, n // 5): 5, max(2, n // 3): 5, n - 2: 7}
+
+
+def run_lineage_differential(
+    family: str,
+    n: int,
+    n_ticks: int = 200,
+    settings: Optional[Settings] = None,
+    seed: int = 5,
+) -> LineageDiffResult:
+    """Fold lineage spans independently on oracle and engine sides.
+
+    Families (see :data:`LINEAGE_FAMILIES`):
+
+    - ``steady``: healthy cluster, no faults — both sides must fold zero
+      spans (the empty stream is part of the contract);
+    - ``crash_burst``: a three-slot crash burst drives organic cut
+      detection, announce and fast-quorum decide;
+    - ``delay``: a crash plus a ``DelayRule`` over a slot block, folded
+      per slot over the adversary referee's per-slot event streams;
+    - ``contested``: a scripted two-way vote split forces the classic
+      fallback, covering the 1a/1b/2a/2b milestones.
+
+    Oracle spans always come from :func:`counter_phase_columns` over
+    ``SimNetwork`` history (``tick_history`` + ``consensus_history`` +
+    recorder events); engine spans come from
+    :func:`engine_phase_columns` over raw ``StepLog`` factor logs for
+    the shared-scan families, and from the adversary engine's counter
+    streams for the delay family.
+    """
+    from rapid_tpu.telemetry import lineage as lineage_mod
+
+    settings = settings or Settings()
+    if family not in LINEAGE_FAMILIES:
+        raise ValueError(f"unknown lineage family {family!r}; "
+                         f"expected one of {LINEAGE_FAMILIES}")
+
+    if family in ("steady", "crash_burst"):
+        from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+        from rapid_tpu.engine.step import simulate
+
+        crash_ticks = {} if family == "steady" else _lineage_crash_burst(n)
+        endpoints = default_endpoints(n)
+        node_ids = default_node_ids(n)
+        fault_model = CrashFault({endpoints[s]: t
+                                  for s, t in crash_ticks.items()})
+        network, clusters, recorders = boot_static_cluster(
+            settings, endpoints, node_ids, fault_model)
+        oracle_counts = run_oracle(network, n_ticks)
+        oracle_phase = [dict(d) for d in network.consensus_history]
+        alive = [s for s in range(n) if s not in crash_ticks]
+        events_oracle = oracle_events(recorders, alive)
+
+        uids = [uid_of(e) for e in endpoints]
+        id_fp_sum = clusters[0].membership_service.view._id_fp_sum
+        state = init_state(uids, id_fp_sum, settings)
+        faults = crash_faults([crash_ticks.get(s, I32_MAX)
+                               for s in range(n)])
+        _, logs = simulate(state, faults, n_ticks, settings)
+
+        oracle_cols = lineage_mod.counter_phase_columns(
+            oracle_counts, oracle_phase, events_oracle)
+        engine_cols = lineage_mod.engine_phase_columns(logs)
+        return LineageDiffResult(
+            family=family, n=n, n_ticks=n_ticks,
+            oracle_spans={"all": lineage_mod.fold_spans(oracle_cols,
+                                                        start_tick=0)},
+            engine_spans={"all": lineage_mod.fold_spans(engine_cols,
+                                                        start_tick=0)},
+        )
+
+    if family == "contested":
+        # Two-way split: half vote to remove slot 0, half slot 1; no fast
+        # quorum forms, slot 0's timer fires and the classic round decides.
+        values = [[0], [1]]
+        votes = {s: (6, s % 2) for s in range(n)}
+        delays = {s: (10 if s == 0 else 100) for s in range(n)}
+        base = _run_fallback_with_logs(n, values, votes, delays,
+                                       min(n_ticks, 40), settings,
+                                       lineage_mod)
+        return LineageDiffResult(family=family, n=n,
+                                 n_ticks=min(n_ticks, 40),
+                                 oracle_spans=base[0], engine_spans=base[1])
+
+    # family == "delay"
+    from rapid_tpu.faults import AdversarySchedule, DelayRule
+
+    block = max(2, n // 8)
+    schedule = AdversarySchedule(
+        n=n,
+        crashes=((n - 1, 11),),
+        delays=(DelayRule(src_slots=frozenset(range(block)),
+                          dst_slots=frozenset(range(block, n // 2)),
+                          delay_ticks=2),),
+        seed=seed)
+    base = run_adversarial_differential(schedule, n_ticks, settings)
+    oracle_spans = {}
+    engine_spans = {}
+    for s in range(n):
+        o_cols = lineage_mod.counter_phase_columns(
+            base.oracle_counters, base.oracle_phase_counters,
+            base.oracle_events_by_slot[s])
+        e_cols = lineage_mod.counter_phase_columns(
+            base.engine_counters, base.engine_phase_counters,
+            base.engine_events_by_slot[s])
+        oracle_spans[f"slot{s}"] = lineage_mod.fold_spans(o_cols,
+                                                          start_tick=0)
+        engine_spans[f"slot{s}"] = lineage_mod.fold_spans(e_cols,
+                                                          start_tick=0)
+    return LineageDiffResult(family=family, n=n, n_ticks=n_ticks,
+                             oracle_spans=oracle_spans,
+                             engine_spans=engine_spans)
+
+
+def _run_fallback_with_logs(n, values, votes, delays, n_ticks, settings,
+                            lineage_mod):
+    """Contested-fallback orchestration that keeps the raw engine logs
+    (``run_fallback_differential`` discards them), so engine-side lineage
+    exercises the ``StepLog`` builder used by campaign and replay."""
+    from rapid_tpu.engine.paxos import plan_fallback
+    from rapid_tpu.engine.state import I32_MAX, crash_faults, init_state
+    from rapid_tpu.engine.step import simulate
+
+    endpoints = default_endpoints(n)
+    node_ids = default_node_ids(n)
+    uids = np.asarray([uid_of(e) for e in endpoints], np.uint64)
+    sched, info = plan_fallback(n, values, votes, delays, settings,
+                                uids=uids)
+
+    network, clusters, recorders = boot_static_cluster(
+        settings, endpoints, node_ids)
+    view0 = clusters[0].membership_service.view
+    ordered = [sorted((endpoints[s] for s in val),
+                      key=view0.ring0_sort_key) for val in values]
+    for tick, s in sorted((vt, vs) for vs, (vt, _) in votes.items()):
+        pid = votes[s][1]
+        network.at(tick, lambda svc=clusters[s].membership_service,
+                   prop=ordered[pid], d=delays[s]:
+                   svc.fast_paxos.propose(prop, recovery_delay_ticks=d))
+    oracle_counts = run_oracle(network, n_ticks)
+    oracle_phase = [dict(d) for d in network.consensus_history]
+    removed = set(values[int(info["winner"])]) if info["winner"] is not None \
+        and int(info["winner"]) >= 0 else set()
+    survivors = [s for s in range(n) if s not in removed]
+    events_oracle = oracle_events(recorders, survivors)
+
+    state = init_state(uids, view0._id_fp_sum, settings)
+    faults = crash_faults([I32_MAX] * n)
+    _, logs = simulate(state, faults, n_ticks, settings, fallback=sched)
+
+    oracle_cols = lineage_mod.counter_phase_columns(
+        oracle_counts, oracle_phase, events_oracle)
+    engine_cols = lineage_mod.engine_phase_columns(logs)
+    return ({"all": lineage_mod.fold_spans(oracle_cols, start_tick=0)},
+            {"all": lineage_mod.fold_spans(engine_cols, start_tick=0)})

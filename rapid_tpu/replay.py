@@ -65,6 +65,7 @@ def _diff_blocks(expected: Dict[str, object], replayed: Dict[str, object]
 
 def replay_member(payload: Dict[str, object], dispatch: int,
                   member_index: int, *, oracle: bool = False,
+                  lineage: bool = False,
                   metrics_path: Optional[str] = None,
                   trace_path: Optional[str] = None,
                   forensics_path: Optional[str] = None
@@ -75,12 +76,15 @@ def replay_member(payload: Dict[str, object], dispatch: int,
     kind, mode, seed), the freshly folded ``replayed`` block in the
     exemplar ``expected`` format, the recorder payload (when the
     campaign carried a flight recorder), the exemplar match verdict
-    (``match`` is None when the member was not flagged), and the oracle
-    differential result when requested.
+    (``match`` is None when the member was not flagged), the member's
+    reconstructed lineage span tree when ``lineage`` is set (verified
+    against the exemplar's recorded spans when the member was flagged),
+    and the oracle differential result when requested.
     """
     import jax
 
     from rapid_tpu import campaign as campaign_mod
+    from rapid_tpu.telemetry import lineage as lineage_lib
     from rapid_tpu.engine import receiver as receiver_mod
     from rapid_tpu.engine import recorder as recorder_mod
     from rapid_tpu.engine.fleet import (fleet_simulate,
@@ -183,6 +187,8 @@ def replay_member(payload: Dict[str, object], dispatch: int,
         cid = (int(np.asarray(mlog.config_hi)[-1]) << 32
                | int(np.asarray(mlog.config_lo)[-1]))
         meta = {"flags": 0, "config_ids": [f"{cid:016x}"]}
+        lineage_spans = (lineage_lib.fold_spans(
+            lineage_lib.engine_phase_columns(mlog)) if lineage else None)
         if writer is not None:
             trace_from_logs(mlog, settings, writer=writer)
     else:
@@ -210,6 +216,17 @@ def replay_member(payload: Dict[str, object], dispatch: int,
         cids = sorted(set(receiver_mod.receiver_config_ids(mrs)[:cfg.n]))
         meta = {"flags": int(np.asarray(mrs.flags)),
                 "config_ids": [f"{x:016x}" for x in cids]}
+        lineage_spans = None
+        if lineage:
+            # Exactly the campaign's per-receiver fold: spans from the
+            # member's own counters, critical path attributed with the
+            # host delay rule when the schedule carries one.
+            lineage_spans = lineage_lib.fold_spans(
+                lineage_lib.receiver_phase_columns(mlog))
+            if sc.schedule.delays:
+                for sp in lineage_spans:
+                    sp["critical_path"] = lineage_lib.receiver_critical_path(
+                        mlog, sp, sc.schedule)
 
     replayed = campaign_mod._expected_block(summary, meta)
     recorder_payload = (recorder_mod.recorder_payload(rec)
@@ -223,11 +240,15 @@ def replay_member(payload: Dict[str, object], dispatch: int,
     cls, exemplar = _find_exemplar(payload, dispatch, member_index)
     mismatches = None
     recorder_match = None
+    lineage_match = None
     if exemplar is not None:
         mismatches = _diff_blocks(exemplar["expected"], replayed)
         if exemplar.get("recorder") is not None \
                 and recorder_payload is not None:
             recorder_match = exemplar["recorder"] == recorder_payload
+        if lineage_spans is not None \
+                and exemplar.get("lineage") is not None:
+            lineage_match = exemplar["lineage"] == lineage_spans
 
     oracle_block = None
     if oracle:
@@ -277,6 +298,8 @@ def replay_member(payload: Dict[str, object], dispatch: int,
         "match": (not mismatches) if mismatches is not None else None,
         "mismatches": mismatches or None,
         "recorder_match": recorder_match,
+        "lineage": lineage_spans,
+        "lineage_match": lineage_match,
         "oracle": oracle_block,
     }
 
@@ -318,6 +341,11 @@ def main(argv=None) -> int:
     parser.add_argument("--oracle", action="store_true",
                         help="also replay the schedule through the host "
                              "oracle referee and report the differential")
+    parser.add_argument("--lineage", action="store_true",
+                        help="reconstruct the member's lineage span tree "
+                             "(phase boundaries, durations, critical "
+                             "path) and verify it against the exemplar's "
+                             "recorded spans when the member was flagged")
     parser.add_argument("--out", type=str, default=None, metavar="FILE",
                         help="write the replay record JSON here too")
     args = parser.parse_args(argv)
@@ -326,7 +354,7 @@ def main(argv=None) -> int:
         payload = json.load(fh)
     dispatch, member_index = args.member
     record = replay_member(payload, dispatch, member_index,
-                           oracle=args.oracle,
+                           oracle=args.oracle, lineage=args.lineage,
                            metrics_path=args.metrics,
                            trace_path=args.trace,
                            forensics_path=args.forensics)
@@ -337,6 +365,7 @@ def main(argv=None) -> int:
     print(json.dumps(record), flush=True)
     failed = (record["match"] is False
               or record["recorder_match"] is False
+              or record["lineage_match"] is False
               or (record["oracle"] or {}).get("passed") is False)
     return 1 if failed else 0
 

@@ -37,6 +37,7 @@ validated by ``telemetry.schema.validate_streaming_stream``.
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -54,6 +55,7 @@ from rapid_tpu.service.status import StatusPublisher
 from rapid_tpu.service.traffic import TrafficConfig, TrafficGenerator
 from rapid_tpu.settings import Settings
 from rapid_tpu.telemetry import engine_metrics, json_artifact_line, summarize
+from rapid_tpu.telemetry.lineage import LineageFold, lineage_summary
 from rapid_tpu.telemetry.metrics import _dist
 from rapid_tpu.telemetry.slo import SloWindows, ViewChangeFold
 
@@ -121,6 +123,13 @@ class ResidentEngine:
         self.servo = servo
         self.slo = slo
         self._vc_fold = ViewChangeFold(0) if slo is not None else None
+        # Lineage rides the same drained gauge rows as the SLO fold; the
+        # rolling window matches the SLO window so a heartbeat's lineage
+        # block decomposes the same chunks the slo block summarizes.
+        self._lineage = LineageFold(0)
+        self.lineage_spans: list = []
+        self._lineage_window: deque = deque(
+            maxlen=slo.window_chunks if slo is not None else 8)
         self.status = status
         self._inert_schedule = (churn_mod.empty_schedule(self.capacity)
                                 if traffic is not None else None)
@@ -227,6 +236,11 @@ class ResidentEngine:
         slo_block = None
         if self.slo is not None:
             slo_block = self.slo.fold_chunk(self._vc_fold.fold(rows))
+        new_spans = self._lineage.fold(rows)
+        self.lineage_spans.extend(new_spans)
+        self._lineage_window.append(new_spans)
+        lineage_block = lineage_summary(
+            [sp for chunk in self._lineage_window for sp in chunk])
         record = {
             "record": "chunk",
             "index": pending["index"],
@@ -242,6 +256,7 @@ class ResidentEngine:
             "traffic": tinfo,
             "servo": servo_block,
             "slo": slo_block,
+            "lineage": lineage_block,
             "checkpoint": pending["checkpoint"],
         }
         self.chunk_records.append(record)
@@ -275,6 +290,7 @@ class ResidentEngine:
             "live_buffer_bytes": record["live_buffer_bytes"],
             "servo": record["servo"],
             "slo": record["slo"],
+            "lineage": record["lineage"],
             "checkpoint": self.checkpoint_block,
             "wall_s": time.perf_counter() - self._wall0,
         }
@@ -308,6 +324,9 @@ class ResidentEngine:
         if self.slo is not None:
             blob["slo"] = self.slo.state_dict()
             blob["vc_fold"] = self._vc_fold.state_dict()
+        blob["lineage"] = {"fold": self._lineage.state_dict(),
+                           "spans": self.lineage_spans,
+                           "window": [list(c) for c in self._lineage_window]}
         return blob
 
     def save(self, path: str) -> dict:
@@ -342,6 +361,12 @@ class ResidentEngine:
                   n_initial=host.get("n_initial"), **kw)
         if eng.slo is not None and "vc_fold" in host:
             eng._vc_fold = ViewChangeFold.from_state(host["vc_fold"])
+        if "lineage" in host:
+            lin = host["lineage"]
+            eng._lineage = LineageFold.from_state(lin["fold"])
+            eng.lineage_spans = list(lin["spans"])
+            for chunk in lin["window"]:
+                eng._lineage_window.append(list(chunk))
         rec = cp.parts.get("recorder")
         # Own buffers before the first donated dispatch: the npz-backed
         # host arrays must not be handed to XLA as donations.
@@ -443,6 +468,7 @@ class ResidentEngine:
             "events_per_sec": _rate(
                 self.traffic.events if self.traffic else 0, wall),
             "ticks_to_view_change": _dist(ttvc),
+            "lineage": lineage_summary(self.lineage_spans),
             "servo": ({"config": self.servo.config.as_dict(),
                        "final": self.servo.chunk_block(
                            self.servo.rate_per_ktick)}

@@ -31,6 +31,7 @@ resident conventions file for file:
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -42,8 +43,11 @@ from rapid_tpu.faults import two_zone_schedule
 from rapid_tpu.service import checkpoint as checkpoint_mod
 from rapid_tpu.service.resident import (_dealias, _live_buffer_bytes,
                                         _rate, _tree_equal)
+from rapid_tpu.service.status import StatusPublisher
 from rapid_tpu.settings import Settings
 from rapid_tpu.telemetry import json_artifact_line
+from rapid_tpu.telemetry.lineage import (LineageFold, lineage_summary,
+                                         receiver_phase_columns)
 from rapid_tpu.telemetry.metrics import _dist
 from rapid_tpu.telemetry.slo import ReceiverViewChangeFold, SloWindows
 
@@ -61,6 +65,7 @@ class ResidentReceiver:
     def __init__(self, carry, faults, settings: Settings, *,
                  capacity: int, chunk_ticks: int,
                  slo: Optional[SloWindows] = None,
+                 status: Optional[StatusPublisher] = None,
                  sink: Optional[str] = None, donate: bool = True):
         if chunk_ticks < 1:
             raise ValueError(f"chunk_ticks must be >= 1, got {chunk_ticks}")
@@ -73,6 +78,11 @@ class ResidentReceiver:
         self.slo = slo
         self._vc_fold = (ReceiverViewChangeFold(self.capacity)
                          if slo is not None else None)
+        self._lineage = LineageFold(0)
+        self.lineage_spans: list = []
+        self._lineage_window: deque = deque(
+            maxlen=slo.window_chunks if slo is not None else 8)
+        self.status = status
         self._donate = donate
         self._sink = open(sink, "w") if sink else None
         self._pending = None
@@ -148,6 +158,11 @@ class ResidentReceiver:
             samples = self._vc_fold.fold(ticks_col, announce_tc, decide_tc)
             self._ttvc.extend(samples["ticks_to_view_change"])
             slo_block = self.slo.fold_chunk(samples)
+        new_spans = self._lineage.fold_columns(receiver_phase_columns(logs))
+        self.lineage_spans.extend(new_spans)
+        self._lineage_window.append(new_spans)
+        lineage_block = lineage_summary(
+            [sp for chunk in self._lineage_window for sp in chunk])
         record = {
             "record": "chunk",
             "index": pending["index"],
@@ -163,10 +178,40 @@ class ResidentReceiver:
             "traffic": None,
             "servo": None,
             "slo": slo_block,
+            "lineage": lineage_block,
             "checkpoint": pending["checkpoint"],
         }
         self.chunk_records.append(record)
         self._emit(record)
+        if self.status is not None:
+            # One frame per chunk, unconditionally — watch cadence must
+            # match chunk cadence even when a chunk closes zero view
+            # changes (the heartbeat itself is the signal).
+            self.status.publish(self._status_snapshot(record))
+
+    def _status_snapshot(self, record: dict) -> dict:
+        """Chunk-boundary ``status_snapshot`` (receiver flavour): built
+        purely from drained host data, never perturbing the stream."""
+        from rapid_tpu.telemetry.schema import SCHEMA_VERSION
+
+        return {
+            "record": "status_snapshot",
+            "schema_version": SCHEMA_VERSION,
+            "source": "resident_receiver",
+            "tick": record["tick"],
+            "chunks": self.chunks,
+            "epoch": -1,
+            "n_members": self.capacity,
+            "ticks_per_sec": record["ticks_per_sec"],
+            "events_per_sec": None,
+            "backlog": None,
+            "live_buffer_bytes": record["live_buffer_bytes"],
+            "servo": None,
+            "slo": record["slo"],
+            "lineage": record["lineage"],
+            "checkpoint": self.checkpoint_block,
+            "wall_s": time.perf_counter() - self._wall0,
+        }
 
     # --- public loop ------------------------------------------------------
 
@@ -194,6 +239,9 @@ class ResidentReceiver:
         if self.slo is not None:
             blob["slo"] = self.slo.state_dict()
             blob["vc_fold"] = self._vc_fold.state_dict()
+        blob["lineage"] = {"fold": self._lineage.state_dict(),
+                           "spans": self.lineage_spans,
+                           "window": [list(c) for c in self._lineage_window]}
         return blob
 
     def save(self, path: str) -> dict:
@@ -221,6 +269,12 @@ class ResidentReceiver:
                  slo=slo, **kw)
         if rx.slo is not None and "vc_fold" in host:
             rx._vc_fold = ReceiverViewChangeFold.from_state(host["vc_fold"])
+        if "lineage" in host:
+            lin = host["lineage"]
+            rx._lineage = LineageFold.from_state(lin["fold"])
+            rx.lineage_spans = list(lin["spans"])
+            for chunk in lin["window"]:
+                rx._lineage_window.append(list(chunk))
         rec = cp.parts.get("recorder")
         rx._rec = _dealias(rec) if rec is not None else None
         rx.chunks = int(host.get("chunks", 0))
@@ -308,6 +362,7 @@ class ResidentReceiver:
             "ticks_per_sec": _rate(self.ticks, wall),
             "events_per_sec": None,
             "ticks_to_view_change": _dist(self._ttvc),
+            "lineage": lineage_summary(self.lineage_spans),
             "servo": None,
             "slo": self.slo.block() if self.slo is not None else None,
             "live_buffer_bytes": {
@@ -328,11 +383,15 @@ class ResidentReceiver:
         if self._sink is not None:
             self._sink.close()
             self._sink = None
+        if self.status is not None:
+            self.status.close()
+            self.status = None
 
 
 def boot_resident_receiver(settings: Settings, n: int, *, seed: int = 0,
                            horizon_ticks: int, chunk_ticks: int,
                            slo: Optional[SloWindows] = None,
+                           status: Optional[StatusPublisher] = None,
                            sink: Optional[str] = None,
                            donate: bool = True) -> ResidentReceiver:
     """Boot the named two-zone deployment as a resident receiver member:
@@ -350,4 +409,4 @@ def boot_resident_receiver(settings: Settings, n: int, *, seed: int = 0,
     # ReceiverState under "xla", a PackedReceiverBundle otherwise.
     return ResidentReceiver(member.state, member.faults, settings,
                             capacity=n, chunk_ticks=chunk_ticks, slo=slo,
-                            sink=sink, donate=donate)
+                            status=status, sink=sink, donate=donate)

@@ -24,8 +24,13 @@ _OPT_INT = (int, type(None))
 #: field is added/renamed/retyped in any payload spec below; every
 #: top-level BENCH artifact carries it as ``schema_version`` and
 #: validation rejects a mismatch (a stale baseline or a stale validator
-#: should fail loudly, not drift).
-SCHEMA_VERSION = 11
+#: should fail loudly, not drift). Schema v12 adds the consensus-lineage
+#: layer (``LINEAGE_*`` specs): campaign payloads carry a required
+#: ``campaign.lineage`` block, tournament variants a per-variant lineage
+#: summary, triage exemplars their span lists, and the streaming records
+#: (chunk / stream_summary / status_snapshot / streaming bench run) an
+#: optional last-window lineage summary.
+SCHEMA_VERSION = 12
 
 #: Protocol variants a campaign/replay payload may record
 #: (``rapid_tpu.variants.VARIANTS``; kept literal here — the schema
@@ -207,6 +212,7 @@ CAMPAIGN_SPEC = {
     "distributions": (dict,),
     "delay_regimes": (dict,),
     "triage": (dict,),
+    "lineage": (dict,),
 }
 
 #: Anomaly classes of the campaign triage block (schema v8), in the
@@ -252,6 +258,9 @@ TRIAGE_EXEMPLAR_SPEC = {
     "seed": (int,),
     "expected": (dict, type(None)),
     "recorder": (dict, type(None)),
+    # Schema v12: the member's lineage span list (null for forced
+    # spot-check refs that never ran in the fleet).
+    "lineage": (list, type(None)),
 }
 
 #: The exemplar ``expected`` block (``campaign._expected_block``): the
@@ -320,6 +329,9 @@ TOURNAMENT_VARIANT_SPEC = {
     "fallback_members": (int,),
     "total_messages": (int,),
     "decide_ticks": (dict,),
+    # Schema v12: per-variant lineage summary — the phase-duration
+    # tails that show *where* a variant pays its latency.
+    "lineage": (dict,),
 }
 
 #: Protocol-variant kernel block of the dominance report (schema v11,
@@ -405,6 +417,66 @@ DISTRIBUTION_SPEC = {
 #: Distribution keys every campaign payload must carry.
 CAMPAIGN_DISTRIBUTIONS = ("ticks_to_first_decide", "total_sent",
                           "messages_per_view_change", "decisions")
+
+# --- consensus lineage (schema v12) ---------------------------------------
+
+#: Phase-duration names of one lineage span, in pipeline order
+#: (``telemetry.lineage.LINEAGE_DURATIONS``; duplicated literal so this
+#: module stays import-light, pinned by ``tests/test_lineage.py``).
+#: For every non-truncated span they sum to ``ticks_to_view_change``.
+LINEAGE_DURATION_NAMES = ("dissemination_ticks", "cut_fill_ticks",
+                          "fast_vote_wait", "fallback_wait",
+                          "classic_phase_ticks")
+
+#: Phase-boundary milestone ticks of one lineage span (null == that
+#: boundary was not observed in the span's window).
+LINEAGE_MILESTONE_NAMES = ("first_alert_tick", "first_report_tick",
+                           "announce_tick", "first_vote_tick",
+                           "fallback_armed_tick", "phase1a_tick",
+                           "phase1b_tick", "phase2a_tick",
+                           "phase2b_tick")
+
+#: One per-view-change lineage span (``telemetry.lineage.fold_spans``).
+#: ``truncated`` spans carry a decide tick and nothing else — a
+#: recorder-ring-evicted window degrades to explicit ignorance, never
+#: to wrong ticks.
+LINEAGE_SPAN_SPEC = {
+    "window_start": _OPT_INT,
+    "decide_tick": (int,),
+    "ticks_to_view_change": _OPT_INT,
+    "fallback": (bool,),
+    "truncated": (bool,),
+    "milestones": (dict,),
+    "durations": (dict,),
+    "critical_path": (dict, type(None)),
+}
+
+#: Critical-path attribution of a per-receiver span: the last-arriving
+#: report/vote edge into the deciding slot, and the index of the
+#: ``DelayRule`` covering that edge (null when no rule slowed it).
+LINEAGE_CRITICAL_PATH_SPEC = {
+    "src": (int,),
+    "dst": (int,),
+    "send_tick": (int,),
+    "arrival_tick": (int,),
+    "delay_rule": _OPT_INT,
+}
+
+#: A lineage span-population summary
+#: (``telemetry.lineage.lineage_summary``): span/fallback/truncated
+#: counts plus one DISTRIBUTION_SPEC block per phase duration.
+LINEAGE_SUMMARY_SPEC = {
+    "spans": (int,),
+    "fallbacks": (int,),
+    "truncated": (int,),
+    "durations": (dict,),
+}
+
+#: The required ``campaign.lineage`` block: the fleet-wide summary plus
+#: per-scenario-kind and per-delay-regime breakdowns (each value one
+#: LINEAGE_SUMMARY_SPEC block).
+CAMPAIGN_LINEAGE_SPEC = dict(LINEAGE_SUMMARY_SPEC,
+                             by_kind=(dict,), by_regime=(dict,))
 
 #: Per-dispatch stage keys of the campaign dispatch observatory (schema
 #: v5), in pipeline order. ``sample``/``lower`` are the host costs
@@ -594,6 +666,9 @@ STREAM_CHUNK_SPEC = {
     # Schema v10: null unless a LoadServo / SloWindows is attached.
     "servo": (dict, type(None)),
     "slo": (dict, type(None)),
+    # Schema v12: rolling last-window lineage summary (null before the
+    # first folded chunk).
+    "lineage": (dict, type(None)),
     "checkpoint": (dict, type(None)),
 }
 
@@ -636,6 +711,9 @@ STREAM_SUMMARY_SPEC = {
     # final rolling SLO window; null when not attached.
     "servo": (dict, type(None)),
     "slo": (dict, type(None)),
+    # Schema v12: whole-run lineage summary (null when the run folded
+    # no lineage).
+    "lineage": (dict, type(None)),
     "checkpoint": (dict, type(None)),
 }
 
@@ -706,6 +784,8 @@ STATUS_SNAPSHOT_SPEC = {
     "live_buffer_bytes": (int,),
     "servo": (dict, type(None)),
     "slo": (dict, type(None)),
+    # Schema v12: the last chunk's rolling lineage summary.
+    "lineage": (dict, type(None)),
     "checkpoint": (dict, type(None)),
     "wall_s": _NUM,
 }
@@ -843,6 +923,73 @@ def validate_flight_recorder(block, where: str = "recorder") -> List[str]:
     return errors
 
 
+def validate_lineage_span(span, where: str = "lineage_span") -> List[str]:
+    """Validate one per-view-change lineage span (schema v12)."""
+    errors = _check(span, LINEAGE_SPAN_SPEC, where)
+    if not isinstance(span, dict):
+        return errors
+    if isinstance(span.get("milestones"), dict):
+        errors += _check(span["milestones"],
+                         {name: _OPT_INT for name in
+                          LINEAGE_MILESTONE_NAMES},
+                         f"{where}.milestones")
+    if isinstance(span.get("durations"), dict):
+        errors += _check(span["durations"],
+                         {name: _OPT_INT for name in
+                          LINEAGE_DURATION_NAMES},
+                         f"{where}.durations")
+    if isinstance(span.get("critical_path"), dict):
+        errors += _check(span["critical_path"], LINEAGE_CRITICAL_PATH_SPEC,
+                         f"{where}.critical_path")
+    return errors
+
+
+def validate_lineage_summary(block, where: str = "lineage") -> List[str]:
+    """Validate one lineage span-population summary (schema v12): every
+    phase duration must carry a distribution block, even when empty."""
+    errors = _check(block, LINEAGE_SUMMARY_SPEC, where)
+    if not isinstance(block, dict):
+        return errors
+    durs = block.get("durations")
+    if isinstance(durs, dict):
+        for name in LINEAGE_DURATION_NAMES:
+            if name not in durs:
+                errors.append(f"{where}.durations.{name}: missing")
+        for name, dist in durs.items():
+            if name not in LINEAGE_DURATION_NAMES:
+                errors.append(f"{where}.durations.{name}: unknown "
+                              f"duration (expected one of "
+                              f"{'/'.join(LINEAGE_DURATION_NAMES)})")
+            errors += _check(dist, DISTRIBUTION_SPEC,
+                             f"{where}.durations.{name}")
+    return errors
+
+
+def validate_campaign_lineage(block, where: str = "campaign.lineage"
+                              ) -> List[str]:
+    """Validate the required ``campaign.lineage`` block: the fleet-wide
+    summary plus ``by_kind``/``by_regime`` breakdown summaries."""
+    errors = validate_lineage_summary(block, where)
+    if not isinstance(block, dict):
+        return errors
+    errors += _check(block, {"by_kind": (dict,), "by_regime": (dict,)},
+                     where)
+    for group in ("by_kind", "by_regime"):
+        sub = block.get(group)
+        if not isinstance(sub, dict):
+            continue
+        for key, summary in sub.items():
+            errors += validate_lineage_summary(summary,
+                                               f"{where}.{group}.{key}")
+        if group == "by_regime":
+            for key in sub:
+                if key not in DELAY_REGIMES:
+                    errors.append(f"{where}.by_regime.{key}: unknown "
+                                  f"regime (expected one of "
+                                  f"{'/'.join(DELAY_REGIMES)})")
+    return errors
+
+
 def validate_triage(block, where: str = "triage") -> List[str]:
     """Validate a campaign ``triage`` block (schema v8)."""
     errors = _check(block, TRIAGE_SPEC, where)
@@ -873,6 +1020,10 @@ def validate_triage(block, where: str = "triage") -> List[str]:
             if isinstance(ex.get("recorder"), dict):
                 errors += validate_flight_recorder(ex["recorder"],
                                                    f"{ew}.recorder")
+            if isinstance(ex.get("lineage"), list):
+                for j, span in enumerate(ex["lineage"]):
+                    errors += validate_lineage_span(
+                        span, f"{ew}.lineage[{j}]")
     return errors
 
 
@@ -902,6 +1053,10 @@ def validate_tournament(block, where: str = "tournament") -> List[str]:
                     and isinstance(row.get("decide_ticks"), dict):
                 errors += _check(row["decide_ticks"], DISTRIBUTION_SPEC,
                                  f"{vw}.decide_ticks")
+            if isinstance(row, dict) \
+                    and isinstance(row.get("lineage"), dict):
+                errors += validate_lineage_summary(row["lineage"],
+                                                   f"{vw}.lineage")
     wl = block.get("win_loss")
     if isinstance(wl, dict):
         for kind, row in wl.items():
@@ -982,6 +1137,9 @@ def validate_campaign(block, where: str = "campaign") -> List[str]:
                              f"{where}.delay_regimes.{key}")
     if "triage" in block:
         errors += validate_triage(block["triage"], f"{where}.triage")
+    if isinstance(block.get("lineage"), dict):
+        errors += validate_campaign_lineage(block["lineage"],
+                                            f"{where}.lineage")
     return errors
 
 
@@ -1158,6 +1316,9 @@ def validate_stream_chunk(rec, where: str = "chunk") -> List[str]:
         errors += _check(rec["servo"], SERVO_CHUNK_SPEC, f"{where}.servo")
     if isinstance(rec.get("slo"), dict):
         errors += validate_slo_window(rec["slo"], f"{where}.slo")
+    if isinstance(rec.get("lineage"), dict):
+        errors += validate_lineage_summary(rec["lineage"],
+                                           f"{where}.lineage")
     if isinstance(rec.get("checkpoint"), dict):
         errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
                          f"{where}.checkpoint")
@@ -1184,6 +1345,9 @@ def validate_stream_summary(rec, where: str = "stream_summary"
         errors += validate_servo_summary(rec["servo"], f"{where}.servo")
     if isinstance(rec.get("slo"), dict):
         errors += validate_slo_window(rec["slo"], f"{where}.slo")
+    if isinstance(rec.get("lineage"), dict):
+        errors += validate_lineage_summary(rec["lineage"],
+                                           f"{where}.lineage")
     if isinstance(rec.get("checkpoint"), dict):
         errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
                          f"{where}.checkpoint")
@@ -1204,6 +1368,9 @@ def validate_status_snapshot(rec, where: str = "status") -> List[str]:
         errors += _check(rec["servo"], SERVO_CHUNK_SPEC, f"{where}.servo")
     if isinstance(rec.get("slo"), dict):
         errors += validate_slo_window(rec["slo"], f"{where}.slo")
+    if isinstance(rec.get("lineage"), dict):
+        errors += validate_lineage_summary(rec["lineage"],
+                                           f"{where}.lineage")
     if isinstance(rec.get("checkpoint"), dict):
         errors += _check(rec["checkpoint"], STREAM_CHECKPOINT_SPEC,
                          f"{where}.checkpoint")
@@ -1329,6 +1496,8 @@ STREAMING_RUN_SPEC = {
     "events_per_sec": (int, float, type(None)),
     "traffic": (dict,),
     "ticks_to_view_change": (dict,),
+    # Schema v12: whole-run lineage summary.
+    "lineage": (dict, type(None)),
     "checkpoint": (dict, type(None)),
 }
 
@@ -1348,6 +1517,9 @@ def validate_run_payload(payload, where: str = "payload") -> List[str]:
             errors += _check(payload["ticks_to_view_change"],
                              DISTRIBUTION_SPEC,
                              f"{where}.ticks_to_view_change")
+        if isinstance(payload.get("lineage"), dict):
+            errors += validate_lineage_summary(payload["lineage"],
+                                               f"{where}.lineage")
         if isinstance(payload.get("checkpoint"), dict):
             errors += _check(payload["checkpoint"], STREAM_CHECKPOINT_SPEC,
                              f"{where}.checkpoint")

@@ -11,7 +11,12 @@ directly. Two process tracks:
   pairs only when the phase did work; instant events mark proposal
   announcements, view-change decisions, and churn activations; counter
   tracks plot membership size, alert-pipeline occupancy, and
-  cut-detector fill per tick.
+  cut-detector fill per tick. A third thread renders the **consensus
+  lineage** span tree: one outer slice per proposal (view-change
+  window), phase slices — dissemination / cut_fill / fast_round —
+  nested under it, and when the fast round lost, a ``fallback`` slice
+  nested under the proposal it superseded with the classic 1a/1b/2a/2b
+  slices inside, every one stamped with the owning proposal/epoch id.
 - **pid 2, host wall-clock**: real-time spans recorded by the
   ``wall_span`` context manager (jit trace+compile, device dispatch,
   ``plan_churn``, host-side topology build). These live on a separate
@@ -34,6 +39,7 @@ VIRTUAL_PID = 1
 WALL_PID = 2
 TID_PHASES = 1
 TID_EVENTS = 2
+TID_LINEAGE = 3
 TID_WALL = 1
 
 #: Intra-tick phase order, matching ``rapid_tpu.engine.step``.
@@ -232,4 +238,65 @@ def trace_from_logs(logs, settings, writer: Optional[TraceWriter] = None,
                        {"batches": int(in_flight[i])})
         writer.counter("cut_reports", base, pid,
                        {"cells": int(cut_reports[i])})
+    lineage_trace_from_logs(logs, settings, writer, pid=pid)
+    return writer
+
+
+def lineage_trace_from_logs(logs, settings,
+                            writer: Optional[TraceWriter] = None,
+                            pid: int = VIRTUAL_PID) -> TraceWriter:
+    """Render the lineage span tree of ``StepLog`` rows as nested slices.
+
+    One outer slice per proposal (view-change window), with the phase
+    slices laid end-to-end inside it at their folded durations, and the
+    classic 1a/1b/2a/2b slices nested inside the ``fallback`` slice of
+    the fast round they superseded. Every nested slice carries the
+    owning ``proposal``/``epoch`` id in its args, so Perfetto groups the
+    chain under its proposal instead of rendering flat phase slices.
+
+    Nested slices are shaved 1–2 us short of their parent's end: the
+    trace-event format closes same-``ts`` E events in emission order,
+    and the parent's E is emitted first.
+    """
+    from rapid_tpu.telemetry import lineage as lineage_lib
+
+    writer = writer or TraceWriter()
+    us_per_tick = settings.tick_ms * 1000
+    ticks = np.asarray(logs.tick)
+    epoch = np.asarray(logs.epoch)
+    spans = lineage_lib.fold_spans(lineage_lib.engine_phase_columns(logs))
+    if spans:
+        writer.meta_process(pid, "rapid-tpu virtual time")
+        writer.meta_thread(pid, TID_LINEAGE, "consensus lineage")
+    for k, span in enumerate(spans):
+        if span["truncated"]:
+            continue
+        s, d = int(span["window_start"]), int(span["decide_tick"])
+        di = np.flatnonzero(ticks == d)
+        e = int(epoch[int(di[0])]) if di.size else -1
+        own = {"proposal": k, "epoch": e}
+        dur = span["durations"]
+        writer.slice(f"proposal {k}", (s + 1) * us_per_tick,
+                     (d - s) * us_per_tick, pid, TID_LINEAGE,
+                     {**own, "fallback": span["fallback"],
+                      "durations": dict(dur)})
+        cur = (s + 1) * us_per_tick
+        fb_ticks = dur["fallback_wait"] + dur["classic_phase_ticks"]
+        for name, n_ticks in (("dissemination", dur["dissemination_ticks"]),
+                              ("cut_fill", dur["cut_fill_ticks"]),
+                              ("fast_round", dur["fast_vote_wait"]),
+                              ("fallback", fb_ticks)):
+            if n_ticks > 0:
+                writer.slice(name, cur, n_ticks * us_per_tick - 1, pid,
+                             TID_LINEAGE, own)
+            cur += n_ticks * us_per_tick
+        if fb_ticks > 0:
+            # Classic phases nest inside the fallback slice; its region
+            # opens one tick after the resolved fast-round boundary.
+            fb_open = d - fb_ticks + 1
+            for pname in ("phase1a", "phase1b", "phase2a", "phase2b"):
+                m = span["milestones"].get(pname + "_tick")
+                if m is not None and fb_open <= m <= d:
+                    writer.slice(pname, m * us_per_tick,
+                                 us_per_tick - 2, pid, TID_LINEAGE, own)
     return writer

@@ -81,7 +81,7 @@ PROTOCOL_RUN_KEYS = (
     "churn_bursts", "burst_size", "contested_instances",
     "ticks_to_first_decide", "messages_per_view_change",
     "events_injected", "joins", "leaves", "bursts", "chunks",
-    "traffic", "ticks_to_view_change", "checkpoint",
+    "traffic", "ticks_to_view_change", "lineage", "checkpoint",
 )
 
 #: Seed-deterministic structural fields of one dispatch_timeline record

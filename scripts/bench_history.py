@@ -126,11 +126,34 @@ def _streaming_cols(parsed: Optional[Dict]) -> Dict[str, Optional[float]]:
     entry = parsed.get("streaming") if isinstance(parsed, dict) else None
     if not isinstance(entry, dict):
         return {"streaming_events_per_sec": None,
-                "streaming_ttvc_p99": None}
+                "streaming_ttvc_p99": None,
+                "streaming_lineage_diss_p99": None,
+                "streaming_lineage_fallback_p99": None}
     ttvc = entry.get("ticks_to_view_change")
+    lineage = _lineage_cols(entry.get("lineage"))
     return {"streaming_events_per_sec": _rate(entry, "events_per_sec"),
             "streaming_ttvc_p99": _rate(ttvc, "p99")
-            if isinstance(ttvc, dict) else None}
+            if isinstance(ttvc, dict) else None,
+            "streaming_lineage_diss_p99": lineage["lineage_diss_p99"],
+            "streaming_lineage_fallback_p99":
+                lineage["lineage_fallback_p99"]}
+
+
+def _lineage_cols(block: Optional[Dict]) -> Dict[str, Optional[float]]:
+    """p99 phase-duration tails from a lineage summary block (schema
+    v12, ``LINEAGE_SUMMARY_SPEC``): where the view changes spent their
+    ticks — dissemination vs fallback wait. None for payloads predating
+    lineage."""
+    durations = block.get("durations") if isinstance(block, dict) else None
+    if not isinstance(durations, dict):
+        return {"lineage_diss_p99": None, "lineage_fallback_p99": None}
+
+    def p99(name):
+        dist = durations.get(name)
+        return _rate(dist, "p99") if isinstance(dist, dict) else None
+
+    return {"lineage_diss_p99": p99("dissemination_ticks"),
+            "lineage_fallback_p99": p99("fallback_wait")}
 
 
 def _fold_bench(path: str) -> Dict[str, object]:
@@ -195,6 +218,8 @@ def _fold_soak(path: str) -> Dict[str, object]:
                               "lost_final_heartbeat": True,
                               "ticks": None, "events_per_sec": None,
                               "ttvc_p99": None, "checkpoint_ok": None,
+                              "lineage_diss_p99": None,
+                              "lineage_fallback_p99": None,
                               "problems": []}
     try:
         with open(path) as fh:
@@ -229,7 +254,8 @@ def _fold_soak(path: str) -> Dict[str, object]:
         checkpoint_ok=all(ck.get(key) for key in
                           ("state_identical", "logs_identical",
                            "final_identical"))
-        if isinstance(ck, dict) else None)
+        if isinstance(ck, dict) else None,
+        **_lineage_cols(summary.get("lineage")))
     if row["checkpoint_ok"] is False:
         row["problems"].append("mid-soak checkpoint round trip was not "
                                "bit-identical")
@@ -357,7 +383,8 @@ def _fold_tournament(path: str) -> Dict[str, object]:
                 "decided": block.get("decided"),
                 "total_messages": block.get("total_messages"),
                 "decide_p99": _rate(ticks, "p99")
-                if isinstance(ticks, dict) else None}
+                if isinstance(ticks, dict) else None,
+                **_lineage_cols(block.get("lineage"))}
     if not row["variants"]:
         row["problems"].append("tournament block has no per-variant "
                                "entries")
@@ -445,8 +472,8 @@ def build_report(directory: str, baseline_path: str) -> Dict[str, object]:
 def render(report: Dict[str, object]) -> str:
     lines = []
     header = (["round", "rc"] + list(RATE_ENTRIES)
-              + ["str ev/s", "str p99", "fleet cl/s", "rx mt/s",
-                 "flags"])
+              + ["str ev/s", "str p99", "str diss99", "str fb99",
+                 "fleet cl/s", "rx mt/s", "flags"])
     rows: List[List[str]] = []
     baseline = report["baseline"]
     for row in ([baseline] if baseline else []) + list(report["rounds"]):
@@ -458,13 +485,24 @@ def render(report: Dict[str, object]) -> str:
                        for name in RATE_ENTRIES]
                     + [_fmt(row.get("streaming_events_per_sec")),
                        _fmt(row.get("streaming_ttvc_p99")),
+                       _fmt(row.get("streaming_lineage_diss_p99")),
+                       _fmt(row.get("streaming_lineage_fallback_p99")),
                        _fmt(row["clusters_per_sec"]),
                        _fmt(row.get("rx_member_ticks_per_sec")), flags])
+    if report.get("no_live_rounds"):
+        # An empty trajectory reads as "no data yet", not a silently
+        # empty table: one explicit banner row below the baseline.
+        rows.append(["no-live-rounds", "--"]
+                    + ["--"] * (len(header) - 3) + ["NO DATA"])
     widths = [max(len(header[i]), *(len(r[i]) for r in rows))
               if rows else len(header[i]) for i in range(len(header))]
     lines.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))
     for r in rows:
         lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    if report.get("no_live_rounds"):
+        lines.append("no-live-rounds: the harness has captured no "
+                     "BENCH_r*/MULTICHIP_r*/SOAK_r*/LOADSWEEP_r*/"
+                     "TOURNAMENT_r* records yet (baseline only)")
     for row in report["multichip"]:
         state = ("ok" if row["ok"] else
                  "skipped" if row["skipped"] else "FAILED")
@@ -481,6 +519,10 @@ def render(report: Dict[str, object]) -> str:
             state = (f"ok ({row['ticks']} ticks, "
                      f"{_fmt(row['events_per_sec'])} ev/s, "
                      f"ttvc p99 {_fmt(row['ttvc_p99'])})")
+            if row.get("lineage_diss_p99") is not None \
+                    or row.get("lineage_fallback_p99") is not None:
+                state += (f" [diss p99 {_fmt(row['lineage_diss_p99'])}, "
+                          f"fb p99 {_fmt(row['lineage_fallback_p99'])}]")
         lines.append(f"soak r{row['round']:02d}: {state} "
                      f"(rc={row['rc']})")
     for row in report.get("load_sweep", []):
@@ -508,10 +550,16 @@ def render(report: Dict[str, object]) -> str:
         else:
             cols = []
             for name, block in sorted(row["variants"].items()):
-                cols.append(
-                    f"{name}: {block['decided']}/{row['clusters']} "
-                    f"decided, p99 {_fmt(block['decide_p99'])}, "
-                    f"{block['total_messages']} msgs")
+                entry = (f"{name}: {block['decided']}/{row['clusters']} "
+                         f"decided, p99 {_fmt(block['decide_p99'])}, "
+                         f"{block['total_messages']} msgs")
+                if block.get("lineage_diss_p99") is not None \
+                        or block.get("lineage_fallback_p99") is not None:
+                    entry += (f" [diss p99 "
+                              f"{_fmt(block.get('lineage_diss_p99'))}, "
+                              f"fb p99 "
+                              f"{_fmt(block.get('lineage_fallback_p99'))}]")
+                cols.append(entry)
             wins = row.get("win_loss") or {}
             won = {name: sum(kinds.get(name, 0)
                              for kinds in wins.values()
@@ -544,10 +592,19 @@ def main(argv=None) -> int:
     if not report["rounds"] and not report["multichip"] \
             and not report["soak"] and not report["load_sweep"] \
             and not report["tournament"]:
-        print(f"bench_history: no BENCH_r*/MULTICHIP_r*/SOAK_r*/"
-              f"LOADSWEEP_r*/TOURNAMENT_r* records under {args.dir}",
-              file=sys.stderr)
-        return 1
+        # "No data yet" is a healthy state, not a failure: render the
+        # baseline with an explicit no-live-rounds banner row and exit 0
+        # even under --strict (there is nothing dead to gate on).
+        report["no_live_rounds"] = True
+        print(render(report))
+        print(f"bench_history: no live rounds under {args.dir} "
+              f"(--strict exempt: an empty trajectory is 'no data "
+              f"yet', not a dead round)", file=sys.stderr)
+        if args.json:
+            with open(args.json, "w") as fh:
+                json.dump(report, fh, indent=2)
+                fh.write("\n")
+        return 0
     print(render(report))
     for row in (report["rounds"] + report["multichip"]
                 + report["soak"] + report["load_sweep"]

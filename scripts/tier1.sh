@@ -334,6 +334,50 @@ sys.exit(1)') \
     fi
 fi
 
+# Lineage smoke: a recorder-on campaign must emit schema-v12 lineage
+# tails (per-kind + aggregate phase-duration distributions folded from
+# the same per-tick gauges the triage path already proves exact), a
+# flagged exemplar must carry its member's lineage spans, and `replay
+# --lineage --trace` must re-derive those spans from the payload alone
+# (the CLI exits 1 on lineage mismatch) while the Perfetto export
+# parses and contains proposal-stamped lineage slices.
+if [ "$rc" -eq 0 ]; then
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.campaign \
+            --clusters 8 --fleet-size 4 --n 16 --ticks 120 --seed 3 \
+            --flight-recorder 24 --out /tmp/_t1_lineage.json >/dev/null \
+        && python -m rapid_tpu.telemetry.schema /tmp/_t1_lineage.json \
+        && ref=$(python -c '
+import json, sys
+from rapid_tpu.telemetry.schema import validate_campaign_lineage
+payload = json.load(open("/tmp/_t1_lineage.json"))
+camp = payload["campaign"]
+lin = camp["lineage"]
+validate_campaign_lineage(lin)
+if lin["spans"] < 1 or not lin["by_kind"]:
+    sys.exit(1)
+for block in camp["triage"]["classes"].values():
+    for ex in block["exemplars"]:
+        if ex["recorder"] is not None and ex.get("lineage"):
+            print("%d:%d" % (ex["dispatch"], ex["member_index"]))
+            sys.exit(0)
+sys.exit(1)') \
+        && timeout -k 10 300 env JAX_PLATFORMS=cpu python -m rapid_tpu.replay \
+            --payload /tmp/_t1_lineage.json --member "$ref" --lineage \
+            --trace /tmp/_t1_lineage_trace.json >/dev/null \
+        && python -c '
+import json, sys
+trace = json.load(open("/tmp/_t1_lineage_trace.json"))
+events = trace.get("traceEvents", [])
+lineage = [e for e in events
+           if e.get("args", {}).get("proposal") is not None]
+sys.exit(0 if lineage else 1)'; then
+        echo LINEAGE_SMOKE=ok
+    else
+        echo LINEAGE_SMOKE=failed
+        rc=1
+    fi
+fi
+
 # Streaming-soak smoke: the resident service must run >=2k ticks as
 # donated chunked scans under open-loop traffic, perform one mid-soak
 # checkpoint save/restore round trip (the CLI itself exits 1 unless the

@@ -108,8 +108,8 @@ def test_campaign_is_deterministic_across_dispatches(tiny_payload,
     assert lines[-1]["record"] == "campaign"
 
 
-def test_campaign_payload_passes_schema_v11(tiny_payload):
-    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 11
+def test_campaign_payload_passes_schema_v12(tiny_payload):
+    assert tiny_payload["schema_version"] == tschema.SCHEMA_VERSION == 12
     assert tschema.validate_bench_payload(tiny_payload) == []
     camp = tiny_payload["campaign"]
     assert camp["clusters"] == TINY.clusters
@@ -147,6 +147,11 @@ def test_campaign_payload_passes_schema_v11(tiny_payload):
         assert sum(p["kinds"].values()) == p["members"]
         assert p["fleet_size"] <= TINY.fleet_size
         assert set(p["shape"]) == set(tschema.DISPATCH_PADDING_SPEC)
+    # v12: the campaign-wide lineage tails, per kind and per regime.
+    lin = camp["lineage"]
+    assert tschema.validate_campaign_lineage(lin) == []
+    assert set(lin["by_kind"]) <= set(camp["scenario_kinds"])
+    assert set(lin["by_regime"]) <= set(tschema.DELAY_REGIMES) | {"no_delay"}
 
 
 def test_dispatch_timeline_observatory(tiny_payload):

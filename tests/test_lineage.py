@@ -1,0 +1,235 @@
+"""Consensus lineage observatory: phase-attributed view-change spans.
+
+The acceptance contract:
+
+- the lineage fold is *derived* data proven bit-identical between the
+  host oracle and the device engine at N=64 (and N=256 under the slow
+  marker) across four scenario families — steady (the empty stream is
+  part of the contract), crash burst, delay adversary (per-slot), and a
+  contested classic fallback;
+- every span obeys the phase-order invariants (announce <= first vote
+  <= decide) and the telescoping identity: the five durations sum
+  exactly to ``ticks_to_view_change``;
+- flight-recorder rings that evicted a window's opening emit that span
+  with ``truncated: true`` and no milestone/duration claims — explicit
+  ignorance instead of invented ticks;
+- the streaming ``LineageFold`` is chunk-split invariant and its
+  checkpoint state round-trips through JSON;
+- the schema v12 field-name constants pin the lineage module's tuples.
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from rapid_tpu.engine.diff import LINEAGE_FAMILIES, run_lineage_differential
+from rapid_tpu.telemetry.lineage import (LINEAGE_DURATIONS,
+                                         LINEAGE_MILESTONES, LineageFold,
+                                         PhaseColumns, fold_spans,
+                                         lineage_from_recorder,
+                                         lineage_summary)
+from rapid_tpu.telemetry.schema import (LINEAGE_DURATION_NAMES,
+                                        LINEAGE_MILESTONE_NAMES,
+                                        validate_lineage_span,
+                                        validate_lineage_summary)
+
+
+# ---------------------------------------------------------------------------
+# oracle vs engine, four families
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def results64():
+    return {family: run_lineage_differential(family, 64)
+            for family in LINEAGE_FAMILIES}
+
+
+def test_lineage_bit_identical_n64_all_families(results64):
+    for family, res in results64.items():
+        res.assert_identical()
+    # Steady state must fold the empty stream on both sides.
+    assert all(not spans
+               for spans in results64["steady"].engine_spans.values())
+    # The fault families must actually exercise the fold.
+    for family in ("crash_burst", "delay", "contested"):
+        assert any(results64[family].engine_spans.values()), family
+    # The contested family covers the classic 1a/1b/2a/2b milestones.
+    contested = [s for spans in results64["contested"].engine_spans.values()
+                 for s in spans]
+    assert any(s["fallback"] and s["milestones"]["phase1a_tick"] is not None
+               for s in contested)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", LINEAGE_FAMILIES)
+def test_lineage_bit_identical_n256(family):
+    run_lineage_differential(family, 256).assert_identical()
+
+
+# ---------------------------------------------------------------------------
+# span invariants
+# ---------------------------------------------------------------------------
+
+
+def test_span_invariants_and_telescoping_sum(results64):
+    spans = [s
+             for family in ("crash_burst", "delay", "contested")
+             for stream in results64[family].engine_spans.values()
+             for s in stream]
+    assert spans
+    for sp in spans:
+        assert validate_lineage_span(sp) == []
+        assert not sp["truncated"]
+        ms, d = sp["milestones"], sp["decide_tick"]
+        assert sp["window_start"] < d
+        for name in LINEAGE_MILESTONES:
+            if ms[name] is not None:
+                assert sp["window_start"] < ms[name] <= d, name
+        if ms["announce_tick"] is not None:
+            if ms["first_vote_tick"] is not None:
+                assert ms["announce_tick"] <= ms["first_vote_tick"]
+        dur = sp["durations"]
+        assert all(v is not None and v >= 0 for v in dur.values())
+        assert sum(dur.values()) == sp["ticks_to_view_change"]
+        if sp["fallback"]:
+            assert dur["fast_vote_wait"] == 0
+        else:
+            assert dur["fallback_wait"] == 0
+            assert dur["classic_phase_ticks"] == 0
+    summary = lineage_summary(spans)
+    assert validate_lineage_summary(summary) == []
+    assert summary["spans"] == len(spans)
+    assert summary["fallbacks"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# recorder-ring truncation
+# ---------------------------------------------------------------------------
+
+_RING_GAUGES = ("tick", "alerts_in_flight", "cut_reports", "vote_tally",
+                "announces", "decides", "px_timers_armed")
+
+
+def _ring_payload(rows, ticks_recorded):
+    return {"gauges": list(_RING_GAUGES),
+            "rows": [list(r) for r in rows],
+            "ticks_recorded": int(ticks_recorded)}
+
+
+def _ring_rows(first_tick):
+    # [tick, alerts, cut_reports, vote_tally, announces, decides, timers]
+    t = first_tick
+    return [
+        [t + 0, 2, 0, 0, 0, 0, 0],
+        [t + 1, 0, 3, 0, 1, 0, 0],
+        [t + 2, 0, 0, 5, 0, 1, 0],   # decide closes window 1
+        [t + 3, 4, 0, 0, 0, 0, 0],
+        [t + 4, 0, 2, 0, 1, 0, 0],
+        [t + 5, 0, 0, 6, 0, 1, 0],   # decide closes window 2
+    ]
+
+
+def test_recorder_truncated_head_is_explicit():
+    # Ring evicted ticks before the retained range: the first in-ring
+    # decide's window opened in the evicted past, so that span must be
+    # truncated with no milestone/duration claims.
+    payload = _ring_payload(_ring_rows(40), ticks_recorded=45 + 6)
+    spans = lineage_from_recorder(payload)
+    assert [s["truncated"] for s in spans] == [True, False]
+    head = spans[0]
+    assert head["window_start"] is None
+    assert head["ticks_to_view_change"] is None
+    assert all(v is None for v in head["milestones"].values())
+    assert all(v is None for v in head["durations"].values())
+    assert validate_lineage_span(head) == []
+    # The second window opened inside the ring: fully attributed.
+    tail = spans[1]
+    assert tail["window_start"] == 42 and tail["decide_tick"] == 45
+    assert sum(tail["durations"].values()) == 3
+    # Truncation is counted, not averaged away.
+    assert lineage_summary(spans)["truncated"] == 1
+
+
+def test_recorder_full_ring_is_not_truncated():
+    payload = _ring_payload(_ring_rows(1), ticks_recorded=6)
+    spans = lineage_from_recorder(payload)
+    assert [s["truncated"] for s in spans] == [False, False]
+    # Ring streams cannot see classic-phase traffic; the fold must not
+    # invent 1a..2b boundaries.
+    assert all(s["milestones"]["phase1a_tick"] is None for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# streaming fold: chunk-split invariance + checkpoint round trip
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_cols():
+    # Two windows; the second decided by classic fallback with every
+    # milestone on a distinct tick, so any chunk-boundary bug shifts a
+    # boundary and fails the comparison.
+    T = 16
+    z = lambda: np.zeros(T, np.int64)
+    cols = {f.name: z() for f in dataclasses.fields(PhaseColumns)}
+    cols["tick"] = np.arange(1, T + 1, dtype=np.int64)
+    cols["announce"] = np.zeros(T, bool)
+    cols["decide"] = np.zeros(T, bool)
+    cols["alert_sent"][[0, 8]] = 3
+    cols["alert_delivered"][[1, 9]] = 2
+    cols["announce"][[2, 10]] = True
+    cols["fast_vote_sent"][[3, 11]] = 5
+    cols["decide"][4] = True
+    cols["timers_armed"][11] = 1
+    cols["phase1a_sent"][12] = 4
+    cols["phase1b_sent"][13] = 3
+    cols["phase2a_sent"][14] = 4
+    cols["phase2b_sent"][15] = 3
+    cols["decide"][15] = True
+    return PhaseColumns(**cols)
+
+
+def _slice_cols(cols, lo, hi):
+    vals = {}
+    for f in dataclasses.fields(PhaseColumns):
+        v = getattr(cols, f.name)
+        vals[f.name] = None if v is None else v[lo:hi]
+    return PhaseColumns(**vals)
+
+
+def test_lineage_fold_chunk_split_invariant():
+    cols = _synthetic_cols()
+    whole = fold_spans(cols, start_tick=0)
+    assert [s["fallback"] for s in whole] == [False, True]
+    assert sum(whole[1]["durations"].values()) == 11
+    T = cols.tick.size
+    for step in (1, 2, 3, 5, 7, 16):
+        fold = LineageFold(0)
+        spans = []
+        for lo in range(0, T, step):
+            spans.extend(fold.fold_columns(_slice_cols(cols, lo, lo + step)))
+        assert spans == whole, f"chunk size {step}"
+
+
+def test_lineage_fold_state_round_trips_through_json():
+    cols = _synthetic_cols()
+    whole = fold_spans(cols, start_tick=0)
+    for cut in (3, 6, 12):
+        fold = LineageFold(0)
+        spans = fold.fold_columns(_slice_cols(cols, 0, cut))
+        # Checkpoint: the open window crosses the save/restore boundary.
+        blob = json.loads(json.dumps(fold.state_dict()))
+        resumed = LineageFold.from_state(blob)
+        spans += resumed.fold_columns(_slice_cols(cols, cut, cols.tick.size))
+        assert spans == whole, f"cut at {cut}"
+
+
+# ---------------------------------------------------------------------------
+# schema pins
+# ---------------------------------------------------------------------------
+
+
+def test_schema_constants_pin_lineage_module():
+    assert tuple(LINEAGE_DURATION_NAMES) == LINEAGE_DURATIONS
+    assert tuple(LINEAGE_MILESTONE_NAMES) == LINEAGE_MILESTONES

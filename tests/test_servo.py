@@ -412,6 +412,54 @@ def test_status_publisher_does_not_perturb_stream(tmp_path):
         assert validate_status_snapshot(json.load(fh)) == []
 
 
+class _RecordingPublisher:
+    """Duck-typed StatusPublisher that records frames instead of
+    serving them — makes heartbeat-cadence assertions deterministic."""
+
+    def __init__(self):
+        self.frames = []
+
+    def publish(self, snapshot):
+        self.frames.append(snapshot)
+
+    def close(self):
+        pass
+
+
+def test_status_frame_every_chunk_even_with_zero_view_changes(tmp_path):
+    # A quiet resident (no traffic, no faults) closes every chunk with
+    # zero view changes. Watch subscribers must still get one frame per
+    # chunk — the heartbeat itself is the signal that the service is
+    # alive, not the view changes inside it.
+    pub = _RecordingPublisher()
+    eng = boot_resident(SETTINGS.with_(stream_chunk_ticks=32), 24, 10,
+                        seed=0, status=pub,
+                        sink=str(tmp_path / "quiet.jsonl"),
+                        write_ticks=False)
+    eng.run(4)
+    eng.close()
+    assert len(pub.frames) == 4
+    for frame in pub.frames:
+        assert validate_status_snapshot(frame) == []
+        assert frame["lineage"]["spans"] == 0
+
+
+def test_rx_resident_status_frame_every_chunk(tmp_path):
+    from rapid_tpu.service import boot_resident_receiver
+
+    pub = _RecordingPublisher()
+    eng = boot_resident_receiver(SETTINGS, 16, seed=3, horizon_ticks=64,
+                                 chunk_ticks=16, status=pub,
+                                 sink=str(tmp_path / "rx.jsonl"))
+    eng.run(4)
+    eng.close()
+    assert len(pub.frames) == 4
+    for frame in pub.frames:
+        assert validate_status_snapshot(frame) == []
+        assert frame["source"] == "resident_receiver"
+        assert frame["lineage"] is not None
+
+
 # ---------------------------------------------------------------------------
 # schema v10 validators
 # ---------------------------------------------------------------------------
